@@ -1,0 +1,20 @@
+"""ouroboros_tpu — a TPU-native rebuild of the Ouroboros network/consensus stack.
+
+Reference: dizgotti/ouroboros-network (Haskell). This package re-designs the
+same capability surface TPU-first:
+
+- ``simharness``  — deterministic async runtime + virtual clock + STM
+                    (io-sim / io-sim-classes analog)
+- ``crypto``      — batched Ed25519 / ECVRF / KES / Blake2b verification,
+                    JAX device kernels + pure CPU reference backend
+- ``chain``       — Point/Tip/HasHeader, AnchoredFragment (chain types)
+- ``network``     — typed protocols, mux, handshake, mini-protocols,
+                    block-fetch decision logic, peer selection, diffusion
+- ``storage``     — HasFS, ImmutableDB, VolatileDB, LedgerDB, ChainDB
+- ``consensus``   — ConsensusProtocol, header validation, ledger, mempool,
+                    node kernel, forging; batched-validation seam
+- ``parallel``    — device mesh + sharded batch-verify (ICI-scaled)
+- ``hfc``         — era composition / time translation (hard-fork combinator)
+"""
+
+__version__ = "0.1.0"

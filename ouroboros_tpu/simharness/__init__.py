@@ -1,0 +1,21 @@
+"""simharness — deterministic async runtime + virtual clock + STM.
+
+The io-sim / io-sim-classes analog (reference: /root/reference/io-sim,
+/root/reference/io-sim-classes).  All higher layers of ouroboros_tpu are
+written against this interface, never against wall-clock asyncio — the
+property that makes whole-system deterministic simulation possible
+(SURVEY.md §1, §4.1).
+"""
+from .core import (
+    Async, AsyncCancelled, Deadlock, Sim, SimEvent, Trace,
+    atomically, current_sim, mask, new_timeout, now, run, run_trace,
+    sleep, spawn, timeout, trace_event, yield_,
+)
+from .stm import Retry, TBQueue, TMVar, TQueue, TVar, Tx, retry
+
+__all__ = [
+    "Async", "AsyncCancelled", "Deadlock", "Sim", "SimEvent", "Trace",
+    "atomically", "current_sim", "mask", "new_timeout", "now", "run",
+    "run_trace", "sleep", "spawn", "timeout", "trace_event", "yield_",
+    "Retry", "TBQueue", "TMVar", "TQueue", "TVar", "Tx", "retry",
+]

@@ -1,0 +1,504 @@
+"""Deterministic async runtime with virtual clock — the io-sim analog.
+
+Reference behaviour being reproduced (see /root/reference):
+- io-sim/src/Control/Monad/IOSim.hs:4-40   (runSim / runSimTrace / Trace)
+- io-sim/src/Control/Monad/IOSim/Internal.hs:682,1085 (schedule/reschedule)
+- io-sim/src/Control/Monad/IOSim/Internal.hs:1300 (execAtomically: STM with
+  retry/orElse), :1095-1112 (timer firing), IOSim.hs:108 (deadlock detection)
+- io-sim-classes typeclasses (MonadSTM/MonadAsync/MonadFork/MonadTimer/...)
+
+Idiomatic rebuild, not a translation: user code is plain Python ``async def``
+coroutines; blocking primitives are awaitables that yield effect records to a
+trampoline scheduler.  The runtime is single-threaded and cooperative, so STM
+transactions are atomic by construction; the STM machinery only needs read-set
+tracking to implement ``retry`` wake-ups.  The scheduler is seeded and fully
+deterministic: same seed, same program -> identical schedule and trace.
+
+Simulation semantics matching io-sim:
+- the run ends when the *main* thread terminates (other threads discarded);
+- when no thread is runnable the clock jumps to the next timer;
+- no runnable thread + no timer + main alive  =>  Deadlock.
+"""
+from __future__ import annotations
+
+import heapq
+import random
+from dataclasses import dataclass
+from typing import Any, Callable, Coroutine, Optional
+
+__all__ = [
+    "run", "run_trace", "spawn", "now", "sleep", "yield_", "atomically",
+    "trace_event", "mask", "Async", "Deadlock", "AsyncCancelled",
+    "SimEvent", "Trace", "current_sim", "timeout", "new_timeout", "Sim",
+]
+
+
+class Deadlock(Exception):
+    """No runnable threads, no pending timers, main not finished.
+
+    io-sim analog: deadlock detection (io-sim/src/Control/Monad/IOSim.hs:108).
+    """
+
+
+class AsyncCancelled(BaseException):
+    """Delivered into a thread by Async.cancel (MonadAsync cancel analog)."""
+
+
+@dataclass(frozen=True)
+class SimEvent:
+    time: float
+    tid: int
+    label: str
+    kind: str          # "fork" | "stop" | "fail" | "delay" | "wake" | "stm" | user label
+    payload: Any = None
+
+    def __repr__(self) -> str:
+        return f"@{self.time:.6f} [{self.tid}:{self.label}] {self.kind} {self.payload!r}"
+
+
+Trace = list  # list[SimEvent]
+
+
+class _Eff:
+    """Awaitable effect record interpreted by the scheduler."""
+    __slots__ = ("kind", "payload")
+
+    def __init__(self, kind: str, payload: Any = None):
+        self.kind = kind
+        self.payload = payload
+
+    def __await__(self):
+        result = yield self
+        return result
+
+
+_RUNNABLE, _BLOCKED, _DONE, _FAILED = "runnable", "blocked", "done", "failed"
+
+
+class _Thread:
+    __slots__ = (
+        "tid", "label", "coro", "state", "resume_value", "resume_exc",
+        "result", "exc", "waiters", "blocked_on", "mask_depth",
+        "pending_cancel", "stm_tx_fn", "block_epoch",
+    )
+
+    def __init__(self, tid: int, label: str, coro: Coroutine):
+        self.tid = tid
+        self.label = label
+        self.coro = coro
+        self.state = _RUNNABLE
+        self.resume_value: Any = None
+        self.resume_exc: Optional[BaseException] = None
+        self.result: Any = None
+        self.exc: Optional[BaseException] = None
+        self.waiters: list[tuple["_Thread", int]] = []
+        self.blocked_on: Any = None
+        self.mask_depth = 0
+        self.pending_cancel = False
+        self.stm_tx_fn: Any = None   # pending STM transaction to re-run on wake
+        # Incremented on every block; wakers capture the epoch at registration
+        # so a stale waker (old timer, old STM registration, old waiter entry)
+        # cannot wake the thread out of a *later* block.
+        self.block_epoch = 0
+
+    @property
+    def masked(self) -> bool:
+        return self.mask_depth > 0
+
+    def block(self, on: Any) -> int:
+        self.state = _BLOCKED
+        self.blocked_on = on
+        self.block_epoch += 1
+        return self.block_epoch
+
+    def __repr__(self):
+        return f"<Thread {self.tid}:{self.label} {self.state} blocked_on={self.blocked_on}>"
+
+
+class Async:
+    """Handle to a forked thread (MonadAsync's Async analog).
+
+    io-sim-classes/src/Control/Monad/Class/MonadAsync.hs:98.
+    """
+
+    __slots__ = ("_thread", "_sim")
+
+    def __init__(self, thread: _Thread, sim: "Sim"):
+        self._thread = thread
+        self._sim = sim
+
+    @property
+    def tid(self) -> int:
+        return self._thread.tid
+
+    @property
+    def label(self) -> str:
+        return self._thread.label
+
+    @property
+    def done(self) -> bool:
+        return self._thread.state in (_DONE, _FAILED)
+
+    async def wait(self) -> Any:
+        """Wait for completion; re-raises the thread's exception if it failed."""
+        return await _Eff("wait", self._thread)
+
+    def cancel(self) -> None:
+        """Deliver AsyncCancelled at the target's next unmasked suspension."""
+        self._sim._cancel(self._thread)
+
+    async def cancel_wait(self) -> None:
+        self.cancel()
+        try:
+            await self.wait()
+        except AsyncCancelled:
+            if not self.done:
+                raise   # the *caller* was cancelled, not the target
+        except Exception:   # target's own failure is reaped silently
+            pass
+
+    def poll(self) -> Optional[Any]:
+        """Non-blocking: result if done, raises if failed, None if running."""
+        t = self._thread
+        if t.state == _FAILED:
+            raise t.exc
+        if t.state == _DONE:
+            return t.result
+        return None
+
+
+_current_sim: Optional["Sim"] = None
+
+
+def current_sim() -> "Sim":
+    if _current_sim is None:
+        raise RuntimeError("not inside a simulation (use simharness.run)")
+    return _current_sim
+
+
+class Sim:
+    def __init__(self, seed: int = 0, collect_trace: bool = False,
+                 explore_schedules: bool = False):
+        self.time = 0.0
+        self._next_tid = 0
+        self._timer_seq = 0
+        self._run_queue: list[_Thread] = []
+        self._timers: list[tuple[float, int, Callable[[], None]]] = []
+        self._threads: dict[int, _Thread] = {}
+        self._trace: Trace = []
+        self._collect = collect_trace
+        self._rng = random.Random(seed)
+        self._explore = explore_schedules
+        self._main: Optional[_Thread] = None
+        self._stm_waiters: dict[int, list[_Thread]] = {}  # tvar id -> threads
+
+    # -- tracing ------------------------------------------------------------
+    def _ev(self, thread: Optional[_Thread], kind: str, payload: Any = None):
+        if self._collect:
+            tid = thread.tid if thread else -1
+            label = thread.label if thread else "sim"
+            self._trace.append(SimEvent(self.time, tid, label, kind, payload))
+
+    # -- thread management --------------------------------------------------
+    def _new_thread(self, coro: Coroutine, label: str) -> _Thread:
+        tid = self._next_tid
+        self._next_tid += 1
+        t = _Thread(tid, label or f"thread-{tid}", coro)
+        self._threads[tid] = t
+        self._run_queue.append(t)
+        self._ev(t, "fork")
+        return t
+
+    def spawn(self, coro: Coroutine, label: str = "") -> Async:
+        return Async(self._new_thread(coro, label), self)
+
+    def _wake(self, thread: _Thread, value: Any = None,
+              exc: Optional[BaseException] = None,
+              epoch: Optional[int] = None):
+        if thread.state != _BLOCKED:
+            return
+        if epoch is not None and epoch != thread.block_epoch:
+            return   # stale waker from an earlier block of this thread
+        thread.state = _RUNNABLE
+        thread.blocked_on = None
+        thread.resume_value = value
+        thread.resume_exc = exc
+        if exc is not None:
+            thread.stm_tx_fn = None   # exception overrides pending STM re-run
+        self._run_queue.append(thread)
+        self._ev(thread, "wake")
+
+    def _cancel(self, thread: _Thread):
+        if thread.state in (_DONE, _FAILED):
+            return
+        thread.pending_cancel = True
+        if thread.state == _BLOCKED and not thread.masked:
+            thread.pending_cancel = False
+            self._wake(thread, exc=AsyncCancelled())
+
+    # -- timers -------------------------------------------------------------
+    def _add_timer(self, delay: float, fn: Callable[[], None]) -> int:
+        self._timer_seq += 1
+        heapq.heappush(self._timers, (self.time + max(delay, 0.0),
+                                      self._timer_seq, fn))
+        return self._timer_seq
+
+    # -- STM integration (stm.py calls these) -------------------------------
+    def stm_block(self, thread: _Thread, tvar_ids, epoch: int):
+        for vid in tvar_ids:
+            self._stm_waiters.setdefault(vid, []).append((thread, epoch))
+
+    def stm_notify(self, tvar_ids):
+        for vid in tvar_ids:
+            for t, ep in self._stm_waiters.pop(vid, ()):
+                # epoch check drops registrations left under *other* tvars by
+                # an earlier wake of the same thread
+                self._wake(t, epoch=ep)  # stm_tx_fn set -> re-run transaction
+
+    # -- main loop ----------------------------------------------------------
+    def run(self, main: Coroutine, label: str = "main") -> Any:
+        global _current_sim
+        prev, _current_sim = _current_sim, self
+        try:
+            self._main = self._new_thread(main, label)
+            while True:
+                if self._main.state == _DONE:
+                    return self._main.result
+                if self._main.state == _FAILED:
+                    raise self._main.exc
+                if not self._run_queue:
+                    if self._timers:
+                        t, _, fn = heapq.heappop(self._timers)
+                        self.time = max(self.time, t)
+                        fn()
+                        continue
+                    blocked = [t for t in self._threads.values()
+                               if t.state == _BLOCKED]
+                    raise Deadlock(
+                        "deadlock: no runnable threads, no timers; blocked: "
+                        + ", ".join(f"{t.tid}:{t.label} on {t.blocked_on}"
+                                    for t in blocked))
+                if self._explore and len(self._run_queue) > 1:
+                    i = self._rng.randrange(len(self._run_queue))
+                    thread = self._run_queue.pop(i)
+                else:
+                    thread = self._run_queue.pop(0)
+                if thread.state != _RUNNABLE:
+                    continue
+                self._step(thread)
+        finally:
+            _current_sim = prev
+
+    def _step(self, thread: _Thread):
+        # pending STM re-run takes priority (unless an exception is queued)
+        if thread.stm_tx_fn is not None and thread.resume_exc is None:
+            tx_fn, thread.stm_tx_fn = thread.stm_tx_fn, None
+            self._run_stm(thread, tx_fn)
+            return
+        if thread.pending_cancel and not thread.masked \
+                and thread.resume_exc is None:
+            thread.pending_cancel = False
+            thread.resume_exc = AsyncCancelled()
+        try:
+            if thread.resume_exc is not None:
+                exc, thread.resume_exc = thread.resume_exc, None
+                eff = thread.coro.throw(exc)
+            else:
+                val, thread.resume_value = thread.resume_value, None
+                eff = thread.coro.send(val)
+        except StopIteration as stop:
+            thread.state = _DONE
+            thread.result = stop.value
+            self._ev(thread, "stop")
+            self._finish(thread)
+            return
+        except AsyncCancelled as exc:
+            thread.state = _FAILED
+            thread.exc = exc
+            self._ev(thread, "cancelled")
+            self._finish(thread)
+            return
+        except BaseException as exc:  # noqa: BLE001 — thread death is data
+            thread.state = _FAILED
+            thread.exc = exc
+            self._ev(thread, "fail", repr(exc))
+            self._finish(thread)
+            return
+        self._handle(thread, eff)
+
+    def _finish(self, thread: _Thread):
+        for w, ep in thread.waiters:
+            if thread.state == _FAILED:
+                self._wake(w, exc=thread.exc, epoch=ep)
+            else:
+                self._wake(w, value=thread.result, epoch=ep)
+        thread.waiters.clear()
+
+    def _handle(self, thread: _Thread, eff: Any):
+        if not isinstance(eff, _Eff):
+            raise RuntimeError(
+                f"thread {thread.label} awaited a non-simharness awaitable: "
+                f"{eff!r} (all blocking ops must go through simharness)")
+        kind = eff.kind
+        if kind == "sleep":
+            ep = thread.block(f"sleep({eff.payload})")
+            self._ev(thread, "delay", eff.payload)
+            self._add_timer(eff.payload,
+                            lambda: self._wake(thread, epoch=ep))
+        elif kind == "yield":
+            thread.state = _RUNNABLE
+            self._run_queue.append(thread)
+        elif kind == "wait":
+            target: _Thread = eff.payload
+            if target.state == _DONE:
+                thread.resume_value = target.result
+                self._run_queue.append(thread)
+            elif target.state == _FAILED:
+                thread.resume_exc = target.exc
+                self._run_queue.append(thread)
+            else:
+                ep = thread.block(f"wait({target.tid}:{target.label})")
+                target.waiters.append((thread, ep))
+        elif kind == "atomically":
+            self._run_stm(thread, eff.payload)
+        elif kind == "mask":
+            thread.mask_depth = max(0, thread.mask_depth + eff.payload)
+            thread.state = _RUNNABLE
+            self._run_queue.append(thread)
+        else:
+            raise RuntimeError(f"unknown effect {kind!r}")
+
+    # STM: run the transaction function now (atomic by construction).
+    def _run_stm(self, thread: _Thread, tx_fn):
+        from . import stm as _stm
+        tx = _stm.Tx(self)
+        try:
+            result = tx_fn(tx)
+        except _stm.Retry:
+            read_ids = list(tx.read_set)
+            tx.rollback()
+            if not read_ids:
+                thread.resume_exc = RuntimeError(
+                    "STM retry with empty read set would block forever")
+                self._run_queue.append(thread)
+                return
+            ep = thread.block(f"STM retry on {len(read_ids)} tvars")
+            thread.stm_tx_fn = tx_fn
+            self._ev(thread, "stm", "retry")
+            self.stm_block(thread, read_ids, ep)
+        except BaseException as exc:  # noqa: BLE001 — surfaced in the thread
+            tx.rollback()
+            thread.resume_exc = exc
+            self._run_queue.append(thread)
+        else:
+            written = tx.commit()
+            if written:
+                self.stm_notify(written)
+            self._ev(thread, "stm", "commit")
+            thread.resume_value = result
+            self._run_queue.append(thread)
+
+
+# ---------------------------------------------------------------------------
+# User-facing API (module-level, operating on the current sim)
+# ---------------------------------------------------------------------------
+
+def run(main: Coroutine, seed: int = 0, explore_schedules: bool = False) -> Any:
+    """Run a simulation to completion; returns main's result (runSimOrThrow)."""
+    return Sim(seed=seed, explore_schedules=explore_schedules).run(main)
+
+
+def run_trace(main: Coroutine, seed: int = 0,
+              explore_schedules: bool = False) -> tuple[Any, Trace]:
+    """runSimTrace analog: returns (result, trace of SimEvents)."""
+    sim = Sim(seed=seed, collect_trace=True, explore_schedules=explore_schedules)
+    result = sim.run(main)
+    return result, sim._trace
+
+
+def spawn(coro: Coroutine, label: str = "") -> Async:
+    return current_sim().spawn(coro, label)
+
+
+def now() -> float:
+    """Virtual monotonic clock (MonadMonotonicTime analog)."""
+    return current_sim().time
+
+
+async def sleep(seconds: float) -> None:
+    """threadDelay analog (io-sim-classes MonadTimer.hs:38)."""
+    await _Eff("sleep", float(seconds))
+
+
+async def yield_() -> None:
+    """Reschedule self to the back of the run queue."""
+    await _Eff("yield")
+
+
+async def atomically(tx_fn) -> Any:
+    """Run an STM transaction; tx_fn receives a Tx handle.
+
+    MonadSTM.atomically analog
+    (io-sim-classes/src/Control/Monad/Class/MonadSTM.hs:162).
+    """
+    return await _Eff("atomically", tx_fn)
+
+
+def trace_event(payload: Any, label: str = "user") -> None:
+    """traceM analog (io-sim/src/Control/Monad/IOSim.hs:16,76)."""
+    sim = current_sim()
+    if sim._collect:
+        sim._trace.append(SimEvent(sim.time, -1, "user", label, payload))
+
+
+class mask:
+    """``async with mask():`` — defer cancellation within the body. Nests.
+
+    MonadMask analog (io-sim-classes MonadThrow.hs:176).
+    """
+
+    async def __aenter__(self):
+        await _Eff("mask", +1)
+        return self
+
+    async def __aexit__(self, *exc):
+        await _Eff("mask", -1)
+        return False
+
+
+async def timeout(seconds: float, coro: Coroutine) -> tuple[bool, Any]:
+    """MonadTimer.timeout analog: (True, result) or (False, None) on expiry."""
+    sim = current_sim()
+    child = sim.spawn(coro, label="timeout-child")
+    fired = {"v": False}
+
+    def on_fire():
+        if not child.done:
+            fired["v"] = True
+            child.cancel()
+
+    sim._add_timer(seconds, on_fire)
+    try:
+        result = await child.wait()
+        return True, result
+    except AsyncCancelled:
+        if fired["v"]:
+            return False, None
+        raise
+    finally:
+        if not child.done:
+            child.cancel()   # caller left early: don't leak the child
+
+
+def new_timeout(seconds: float):
+    """registerDelay analog: returns a TVar that flips to True at expiry."""
+    from . import stm as _stm
+    sim = current_sim()
+    tv = _stm.TVar(False, label=f"timeout@{sim.time + seconds:.6f}")
+
+    def fire():
+        tv._value = True
+        sim.stm_notify([tv._id])
+
+    sim._add_timer(seconds, fire)
+    return tv
