@@ -1,0 +1,16 @@
+"""crypto — batched verification primitives for the consensus hot path.
+
+CPU reference implementations (edwards / ed25519_ref / vrf_ref / kes) +
+batched JAX device kernels (field_jax / ed25519_jax / vrf_jax) behind the
+CryptoBackend seam (backend.py).  See SURVEY.md §2 (crypto accounting) and
+BASELINE.md (north-star workloads).
+"""
+from .backend import (
+    CpuRefBackend, CryptoBackend, Ed25519Req, KesReq, OpensslBackend,
+    VrfReq, default_backend, set_default_backend,
+)
+
+__all__ = [
+    "CpuRefBackend", "CryptoBackend", "Ed25519Req", "KesReq",
+    "OpensslBackend", "VrfReq", "default_backend", "set_default_backend",
+]

@@ -1,0 +1,139 @@
+"""CryptoBackend — the batched-verification seam of the whole framework.
+
+Reference seam being generalised: the `StandardCrypto` associated-type bundle
+(Shelley/Protocol/Crypto.hs:15-23) reached through typeclass indirection from
+`updateChainDepState` (VRF+KES per header) and `applyLedgerBlock` (Ed25519
+witness multi-verify per body) — SURVEY.md §2 "The TPU-relevant gap": the
+reference verifies strictly sequentially; nothing batches independent proofs.
+
+This trait makes batching first-class.  All three request kinds are *batch*
+APIs returning a boolean vector; consensus code collects independent proofs
+from a window of headers/blocks and calls one of these once per window
+(consensus/batch_validation.py drives it).
+
+Backends:
+- CpuRefBackend     — pure-Python (edwards.py); ground truth, slow.
+- OpensslBackend    — `cryptography` Ed25519 (libsodium-class C speed) for
+                      the signature leaves; VRF still pure-Python.
+- JaxBackend        — batched device kernels (ed25519_jax.py), host does
+                      hashing/decompression, device does the group math;
+                      shards across a mesh via parallel/sharded_verify.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from . import ed25519_ref, kes as kes_mod, vrf_ref
+
+
+@dataclass(frozen=True)
+class Ed25519Req:
+    vk: bytes        # 32B verification key
+    msg: bytes
+    sig: bytes       # 64B
+
+
+@dataclass(frozen=True)
+class VrfReq:
+    vk: bytes        # 32B
+    alpha: bytes     # VRF input
+    proof: bytes     # 80B
+
+
+@dataclass(frozen=True)
+class KesReq:
+    depth: int
+    vk: bytes        # 32B root hash
+    period: int
+    msg: bytes
+    sig_bytes: bytes
+
+
+class CryptoBackend:
+    """Batch verification interface. Implementations must be bit-exact."""
+
+    name = "abstract"
+
+    def verify_ed25519_batch(self, reqs: Sequence[Ed25519Req]) -> list[bool]:
+        raise NotImplementedError
+
+    def verify_vrf_batch(self, reqs: Sequence[VrfReq]) -> list[bool]:
+        raise NotImplementedError
+
+    def verify_kes_batch(self, reqs: Sequence[KesReq]) -> list[bool]:
+        """Default: host hash-path check + ed25519 batch on the leaves."""
+        leaf_reqs: list[Ed25519Req] = []
+        slots: list[Optional[int]] = []
+        for r in reqs:
+            try:
+                sig = kes_mod.KesSig.from_bytes(r.depth, r.sig_bytes)
+            except ValueError:
+                slots.append(None)
+                continue
+            prep = kes_mod.verify_prepare(r.depth, r.vk, r.period, sig)
+            if prep is None:
+                slots.append(None)
+            else:
+                leaf_vk, leaf_sig = prep
+                slots.append(len(leaf_reqs))
+                leaf_reqs.append(Ed25519Req(leaf_vk, r.msg, leaf_sig))
+        leaf_ok = self.verify_ed25519_batch(leaf_reqs) if leaf_reqs else []
+        return [False if i is None else leaf_ok[i] for i in slots]
+
+    # VRF outputs (beta) for leader election — host-side, cheap
+    def vrf_proof_to_hash(self, proof: bytes) -> bytes:
+        return vrf_ref.proof_to_hash(proof)
+
+
+class CpuRefBackend(CryptoBackend):
+    """Pure-Python ground truth."""
+
+    name = "cpu-ref"
+
+    def verify_ed25519_batch(self, reqs):
+        return [ed25519_ref.verify(r.vk, r.msg, r.sig) for r in reqs]
+
+    def verify_vrf_batch(self, reqs):
+        return [vrf_ref.verify(r.vk, r.alpha, r.proof) for r in reqs]
+
+
+class OpensslBackend(CpuRefBackend):
+    """Ed25519 via OpenSSL (`cryptography`) — the fast-CPU fallback path
+    (the role libsodium plays in the reference deployment)."""
+
+    name = "cpu-openssl"
+
+    def verify_ed25519_batch(self, reqs):
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PublicKey,
+        )
+        out = []
+        for r in reqs:
+            try:
+                Ed25519PublicKey.from_public_bytes(r.vk).verify(r.sig, r.msg)
+                out.append(True)
+            except (InvalidSignature, ValueError):
+                out.append(False)
+        return out
+
+
+_default: Optional[CryptoBackend] = None
+
+
+def default_backend() -> CryptoBackend:
+    """Best available backend: JAX device if importable, else OpenSSL CPU."""
+    global _default
+    if _default is None:
+        try:
+            from .jax_backend import JaxBackend
+            _default = JaxBackend()
+        except Exception:   # no jax / no device: CPU fallback
+            _default = OpensslBackend()
+    return _default
+
+
+def set_default_backend(b: Optional[CryptoBackend]) -> None:
+    global _default
+    _default = b

@@ -1,0 +1,66 @@
+"""RFC 8032 Ed25519 sign/verify — pure-Python CPU reference backend.
+
+Role in the framework: the DSIGN algorithm of the consensus protocol stack
+(reference seam: cardano-crypto-class DSIGNAlgorithm, pinned to Ed25519DSIGN
+in Shelley/Protocol/Crypto.hs:15-23).  The batched TPU path
+(ed25519_jax.py) must agree bit-for-bit with this module; tests also
+cross-check against the OpenSSL implementation in `cryptography`.
+"""
+from __future__ import annotations
+
+from . import edwards as ed
+from .edwards import BASE, L, P
+
+
+def _clamp(k: bytes) -> int:
+    a = bytearray(k)
+    a[0] &= 248
+    a[31] &= 127
+    a[31] |= 64
+    return int.from_bytes(bytes(a), "little")
+
+
+def secret_expand(sk: bytes) -> tuple[int, bytes]:
+    """seed -> (secret scalar, nonce prefix)."""
+    h = ed.sha512(sk)
+    return _clamp(h[:32]), h[32:]
+
+
+def public_key(sk: bytes) -> bytes:
+    a, _ = secret_expand(sk)
+    return ed.compress(ed.scalar_mult(a, BASE))
+
+
+def sign(sk: bytes, msg: bytes) -> bytes:
+    a, prefix = secret_expand(sk)
+    vk = ed.compress(ed.scalar_mult(a, BASE))
+    r = ed.sha512_int(prefix, msg) % L
+    R = ed.compress(ed.scalar_mult(r, BASE))
+    k = ed.sha512_int(R, vk, msg) % L
+    s = (r + k * a) % L
+    return R + int.to_bytes(s, 32, "little")
+
+
+def verify(vk: bytes, msg: bytes, sig: bytes) -> bool:
+    """RFC 8032 verify: [s]B == R + [k]A  (cofactorless, as libsodium)."""
+    if len(sig) != 64 or len(vk) != 32:
+        return False
+    A = ed.decompress(vk)
+    R = ed.decompress(sig[:32])
+    if A is None or R is None:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= L:
+        return False
+    k = ed.sha512_int(sig[:32], vk, msg) % L
+    sB = ed.scalar_mult(s, BASE)
+    kA = ed.scalar_mult(k, A)
+    return ed.pt_equal(sB, ed.pt_add(R, kA))
+
+
+def verify_prepared(A, R, s: int, k: int) -> bool:
+    """Verify from pre-decoded points/scalars (the shape the batched device
+    kernel consumes: hashing+decompression on host, group math on device)."""
+    sB = ed.scalar_mult(s, BASE)
+    kA = ed.scalar_mult(k, A)
+    return ed.pt_equal(sB, ed.pt_add(R, kA))

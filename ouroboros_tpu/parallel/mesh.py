@@ -1,0 +1,35 @@
+"""Device mesh construction for sharded batch validation."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+
+WINDOW_AXIS = "window"   # the header-window (proof-batch) axis
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis: str = WINDOW_AXIS) -> Mesh:
+    """1-D mesh over the first n_devices devices.
+
+    The framework's device-parallel dimension is the proof batch — the
+    window of independent headers/tx-witnesses being validated (the
+    sequence-parallel analog for a blockchain's 'sequence').  A 1-D mesh
+    suffices because the ladder kernel has no cross-example communication;
+    psum aggregation is the only collective.
+    """
+    # Honor JAX_PLATFORMS explicitly: some platform plugins (e.g. the axon
+    # TPU tunnel) keep themselves as the default backend regardless, which
+    # would silently ignore a requested virtual CPU mesh.
+    import os
+    plat = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() or None
+    devs = jax.devices(plat) if plat else jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devs)}")
+        devs = devs[:n_devices]
+    import numpy as np
+    return Mesh(np.array(devs), (axis,))

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import heapq
 import random
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable, Coroutine, Optional
 
@@ -151,9 +152,11 @@ class Async:
         self.cancel()
         try:
             await self.wait()
-        except AsyncCancelled:
-            if not self.done:
-                raise   # the *caller* was cancelled, not the target
+        except AsyncCancelled as e:
+            # Only swallow the *target's* death; a fresh AsyncCancelled not
+            # identical to the target's exc is the caller's own cancellation.
+            if not self.done or self._thread.exc is not e:
+                raise
         except Exception:   # target's own failure is reaped silently
             pass
 
@@ -182,7 +185,7 @@ class Sim:
         self.time = 0.0
         self._next_tid = 0
         self._timer_seq = 0
-        self._run_queue: list[_Thread] = []
+        self._run_queue: deque[_Thread] = deque()
         self._timers: list[tuple[float, int, Callable[[], None]]] = []
         self._threads: dict[int, _Thread] = {}
         self._trace: Trace = []
@@ -190,7 +193,8 @@ class Sim:
         self._rng = random.Random(seed)
         self._explore = explore_schedules
         self._main: Optional[_Thread] = None
-        self._stm_waiters: dict[int, list[_Thread]] = {}  # tvar id -> threads
+        # tvar id -> [(thread, epoch), ...] blocked on an STM retry
+        self._stm_waiters: dict[int, list[tuple[_Thread, int]]] = {}
 
     # -- tracing ------------------------------------------------------------
     def _ev(self, thread: Optional[_Thread], kind: str, payload: Any = None):
@@ -246,7 +250,13 @@ class Sim:
     # -- STM integration (stm.py calls these) -------------------------------
     def stm_block(self, thread: _Thread, tvar_ids, epoch: int):
         for vid in tvar_ids:
-            self._stm_waiters.setdefault(vid, []).append((thread, epoch))
+            waiters = self._stm_waiters.setdefault(vid, [])
+            if waiters:
+                # prune stale registrations (earlier blocks of any thread) so
+                # never-written tvars don't accumulate dead entries unboundedly
+                waiters[:] = [(t, ep) for t, ep in waiters
+                              if ep == t.block_epoch and t.state == _BLOCKED]
+            waiters.append((thread, epoch))
 
     def stm_notify(self, tvar_ids):
         for vid in tvar_ids:
@@ -279,15 +289,26 @@ class Sim:
                         + ", ".join(f"{t.tid}:{t.label} on {t.blocked_on}"
                                     for t in blocked))
                 if self._explore and len(self._run_queue) > 1:
+                    # O(n) pick is fine: exploration mode is for tests
                     i = self._rng.randrange(len(self._run_queue))
-                    thread = self._run_queue.pop(i)
+                    self._run_queue.rotate(-i)
+                    thread = self._run_queue.popleft()
+                    self._run_queue.rotate(i)
                 else:
-                    thread = self._run_queue.pop(0)
+                    thread = self._run_queue.popleft()
                 if thread.state != _RUNNABLE:
                     continue
                 self._step(thread)
         finally:
             _current_sim = prev
+            # Close coroutines of threads outliving the simulation so their
+            # finally/__aexit__ blocks run and GC sees no un-awaited frames.
+            for t in self._threads.values():
+                if t.state not in (_DONE, _FAILED):
+                    try:
+                        t.coro.close()
+                    except RuntimeError:
+                        pass   # coroutine ignored GeneratorExit (awaited again)
 
     def _step(self, thread: _Thread):
         # pending STM re-run takes priority (unless an exception is queued)
@@ -481,8 +502,10 @@ async def timeout(seconds: float, coro: Coroutine) -> tuple[bool, Any]:
     try:
         result = await child.wait()
         return True, result
-    except AsyncCancelled:
-        if fired["v"]:
+    except AsyncCancelled as e:
+        # (False, None) only for the child's own timer-induced death; the
+        # caller's own cancellation (a different exception object) re-raises.
+        if fired["v"] and child._thread.exc is e:
             return False, None
         raise
     finally:

@@ -478,3 +478,73 @@ def test_stale_sleep_timer_does_not_wake_later_sleep():
         h.cancel()
         return await h.wait()
     assert sim.run(main()) == 101.0
+
+
+def test_cancel_wait_on_done_target_does_not_eat_own_cancel():
+    """cancel_wait over an already-done target must re-raise the caller's
+    own (distinct) cancellation instead of attributing it to the target."""
+    async def main():
+        async def quick():
+            return 1
+
+        async def reaper(h):
+            try:
+                await sim.yield_()
+                await h.cancel_wait()
+            except AsyncCancelled:
+                return "own-cancel-raised"
+            await sim.sleep(10.0)
+            return "survived"
+
+        h = sim.spawn(quick())
+        r = sim.spawn(reaper(h))
+        await sim.yield_()
+        await sim.yield_()
+        # r is now suspended at cancel_wait's wait-effect on the done target
+        r.cancel()
+        return await r.wait()
+    assert sim.run(main()) == "own-cancel-raised"
+
+
+def test_orphan_threads_closed_at_sim_end():
+    """Threads still alive when main returns get their finally blocks run."""
+    log = []
+
+    async def main():
+        async def orphan():
+            try:
+                await sim.sleep(1000.0)
+            finally:
+                log.append("cleaned")
+
+        sim.spawn(orphan())
+        await sim.sleep(1.0)
+        return "done"
+
+    assert sim.run(main()) == "done"
+    assert log == ["cleaned"]
+
+
+def test_stm_waiter_lists_do_not_accumulate():
+    """Retrying on {a,b} where only b is written must not grow a's list."""
+    async def main():
+        a, b = TVar(None), TVar(0)
+
+        async def consumer():
+            for want in range(1, 21):
+                def tx_fn(tx, want=want):
+                    if tx.read(a) is None and tx.read(b) < want:
+                        raise Retry()
+                    return tx.read(b)
+                await sim.atomically(tx_fn)
+
+        async def producer():
+            for i in range(1, 21):
+                await sim.sleep(1.0)
+                await sim.atomically(lambda tx, i=i: tx.write(b, i))
+
+        c = sim.spawn(consumer())
+        sim.spawn(producer())
+        await c.wait()
+        return len(sim.current_sim()._stm_waiters.get(a._id, []))
+    assert sim.run(main()) <= 1
