@@ -102,11 +102,10 @@ class KesSignKey:
     # -- public api ---------------------------------------------------------
     @property
     def verification_key(self) -> bytes:
-        vk = dsign.public_key(self._leaf_sk)
-        for lv in reversed(self._levels):
-            vkl, vkr = lv["vks"]
-            vk = _blake2b_256(vkl, vkr)
-        return vk
+        if not self._levels:          # depth 0: plain ed25519
+            return dsign.public_key(self._leaf_sk)
+        vkl, vkr = self._levels[0]["vks"]   # root level
+        return _blake2b_256(vkl, vkr)
 
     def sign(self, msg: bytes) -> KesSig:
         leaf_sig = dsign.sign(self._leaf_sk, msg)

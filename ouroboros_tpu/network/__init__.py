@@ -1,0 +1,5 @@
+"""network — typed protocols, channels, mux, mini-protocols, diffusion.
+
+Reference layers L1-L4 (SURVEY.md §1): typed-protocols, network-mux,
+ouroboros-network-framework, ouroboros-network.
+"""

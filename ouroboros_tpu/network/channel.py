@@ -1,0 +1,47 @@
+"""Channels — in-memory duplex links used by drivers, tests, and ThreadNet.
+
+Reference: ouroboros-network-framework/src/Ouroboros/Network/Channel.hs
+(createConnectedChannels + delay/loss variants used by ThreadNet,
+SURVEY.md §4.3).  Built on simharness STM queues, so whole networks run
+deterministically in simulation.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from .. import simharness as sim
+from ..simharness import TBQueue
+
+
+class Channel:
+    """One direction-pair endpoint: send/recv of opaque items (bytes for
+    wire-level channels, message objects for Direct-style tests)."""
+
+    def __init__(self, outq: TBQueue, inq: TBQueue, delay: float = 0.0,
+                 label: str = ""):
+        self._out = outq
+        self._in = inq
+        self._delay = delay
+        self.label = label
+
+    async def send(self, item: Any) -> None:
+        if self._delay:
+            await sim.sleep(self._delay)
+        await sim.atomically(lambda tx: self._out.put(tx, item))
+
+    async def recv(self) -> Any:
+        return await sim.atomically(self._in.get)
+
+    def try_recv(self):
+        """Non-blocking receive attempt (None if empty); STM-free peek used
+        by the mux demuxer's fairness loop."""
+        raise NotImplementedError("use recv inside the sim")
+
+
+def channel_pair(capacity: int = 64, delay: float = 0.0,
+                 label: str = "chan") -> Tuple[Channel, Channel]:
+    """Two connected endpoints; what A sends, B receives (and vice versa)."""
+    ab = TBQueue(capacity, label=f"{label}.ab")
+    ba = TBQueue(capacity, label=f"{label}.ba")
+    return (Channel(ab, ba, delay, label + ".A"),
+            Channel(ba, ab, delay, label + ".B"))

@@ -1,0 +1,229 @@
+"""Multiplexer — one bearer, N mini-protocol byte streams.
+
+Reference: network-mux/src/Network/Mux.hs (newMux/runMux/miniProtocolJob),
+Egress.hs:77-105 (single writer, fair SDU interleaving), Ingress.hs:100-122
+(per-protocol ingress queues with byte limits), Codec.hs:16-40 (8-byte SDU
+header: 32-bit timestamp | 1-bit mode + 15-bit protocol num | 16-bit length,
+big-endian), Bearer/Queues.hs:25 (pure queue bearer for tests).
+
+Wire-compatible SDU framing; the runtime is simharness threads + STM, so mux
+behaviour (fairness, backpressure, overflow kills) is deterministic in tests.
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Optional
+
+from .. import simharness as sim
+from ..simharness import TBQueue, TVar, retry
+
+INITIATOR, RESPONDER = 0, 1
+HEADER = struct.Struct(">IHH")   # timestamp, mode|num, length
+
+
+class MuxError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class SDU:
+    timestamp: int      # lower 32 bits of sender's µs clock (RemoteClockModel)
+    mode: int           # INITIATOR | RESPONDER (direction bit)
+    num: int            # protocol number (15 bits)
+    payload: bytes
+
+    def encode(self) -> bytes:
+        if self.num >= 1 << 15:
+            raise MuxError("protocol number out of range")
+        if len(self.payload) >= 1 << 16:
+            raise MuxError("SDU payload too large")
+        return HEADER.pack(self.timestamp & 0xFFFFFFFF,
+                           (self.mode << 15) | self.num,
+                           len(self.payload)) + self.payload
+
+    @classmethod
+    def decode_header(cls, raw: bytes) -> tuple[int, int, int, int]:
+        ts, mn, ln = HEADER.unpack(raw[:8])
+        return ts, mn >> 15, mn & 0x7FFF, ln
+
+
+class QueueBearer:
+    """In-memory bearer: SDU-preserving queue pair (Bearer/Queues.hs:25)."""
+
+    def __init__(self, outq: TBQueue, inq: TBQueue, sdu_size: int = 12288,
+                 delay: float = 0.0):
+        self.sdu_size = sdu_size
+        self._out = outq
+        self._in = inq
+        self._delay = delay
+
+    async def write(self, sdu: SDU) -> None:
+        raw = sdu.encode()
+        if self._delay:
+            await sim.sleep(self._delay)
+        await sim.atomically(lambda tx: self._out.put(tx, raw))
+
+    async def read(self) -> SDU:
+        raw = await sim.atomically(self._in.get)
+        ts, mode, num, ln = SDU.decode_header(raw)
+        payload = raw[8:]
+        if len(payload) != ln:
+            raise MuxError("SDU length mismatch")
+        return SDU(ts, mode, num, payload)
+
+
+def bearer_pair(sdu_size: int = 12288, delay: float = 0.0, capacity: int = 256):
+    a2b = TBQueue(capacity, label="bearer.a2b")
+    b2a = TBQueue(capacity, label="bearer.b2a")
+    return (QueueBearer(a2b, b2a, sdu_size, delay),
+            QueueBearer(b2a, a2b, sdu_size, delay))
+
+
+class MuxChannel:
+    """Byte-stream channel for one (protocol num, direction)."""
+
+    def __init__(self, mux: "Mux", num: int, mode: int):
+        self._mux = mux
+        self._num = num
+        self._mode = mode
+        # egress staging (drained by the muxer thread, Egress.hs Wanton)
+        self.egress = TVar(b"", label=f"mux.egress.{num}.{mode}")
+        # ingress chunks + byte accounting (Ingress.hs)
+        self.ingress = TVar(b"", label=f"mux.ingress.{num}.{mode}")
+        self.ingress_limit = 0x3FFFF
+
+    async def send(self, data: bytes) -> None:
+        """Queue bytes for egress; blocks while previous data undrained
+        (the Wanton backpressure of Egress.hs:77)."""
+        def tx_fn(tx):
+            cur = tx.read(self.egress)
+            if len(cur) + len(data) > 0xFFFF * 4:
+                retry()
+            tx.write(self.egress, cur + data)
+        await sim.atomically(tx_fn)
+
+    async def recv(self) -> bytes:
+        """Receive whatever bytes have arrived (at least one)."""
+        def tx_fn(tx):
+            buf = tx.read(self.ingress)
+            if not buf:
+                retry()
+            tx.write(self.ingress, b"")
+            return buf
+        return await sim.atomically(tx_fn)
+
+
+class Mux:
+    """The mux proper: fair egress servicing + demux (Mux.hs:176-282)."""
+
+    def __init__(self, bearer, label: str = "mux"):
+        self.bearer = bearer
+        self.label = label
+        self._channels: dict[tuple[int, int], MuxChannel] = {}
+        self._jobs: list = []
+        # bumped on channel registration so the egress loop's STM retry
+        # re-reads the channel set (a snapshot would miss late channels)
+        self._chan_version = TVar(0, label=f"{label}.chanver")
+
+    def channel(self, num: int, mode: int) -> MuxChannel:
+        key = (num, mode)
+        if key not in self._channels:
+            self._channels[key] = MuxChannel(self, num, mode)
+            if self._jobs:   # mux running: wake the egress loop
+                self._chan_version.set_notify(self._chan_version.value + 1)
+            else:
+                self._chan_version._value += 1
+        return self._channels[key]
+
+    def start(self) -> None:
+        self._jobs.append(sim.spawn(self._egress_loop(),
+                                    label=f"{self.label}.muxer"))
+        self._jobs.append(sim.spawn(self._demux_loop(),
+                                    label=f"{self.label}.demuxer"))
+
+    def stop(self) -> None:
+        for j in self._jobs:
+            j.cancel()
+
+    async def _egress_loop(self):
+        """Round-robin over channels; one SDU per channel per cycle
+        (Egress.hs:77-105 fairness)."""
+        while True:
+            # wait until any channel has egress data; reading _chan_version
+            # inside the transaction adds it to the retry read set, so late
+            # channel registrations wake this loop
+            def wait_any(tx):
+                tx.read(self._chan_version)
+                for ch in self._channels.values():
+                    if tx.read(ch.egress):
+                        return True
+                retry()
+            await sim.atomically(wait_any)
+            for ch in list(self._channels.values()):
+                def take(tx, ch=ch):
+                    buf = tx.read(ch.egress)
+                    if not buf:
+                        return None
+                    cut = self.bearer.sdu_size
+                    tx.write(ch.egress, buf[cut:])
+                    return buf[:cut]
+                chunk = await sim.atomically(take)
+                if chunk:
+                    ts = int(sim.now() * 1e6) & 0xFFFFFFFF
+                    await self.bearer.write(
+                        SDU(ts, ch._mode, ch._num, chunk))
+
+    async def _demux_loop(self):
+        """Read SDUs, route to ingress queues; overflow kills the mux
+        (Ingress.hs:100-122 MuxIngressQueueOverRun semantics)."""
+        while True:
+            sdu = await self.bearer.read()
+            # the sender's direction bit is flipped on receive: the remote
+            # initiator's data feeds our responder-side channel (Ingress.hs)
+            key = (sdu.num, 1 - sdu.mode)
+            ch = self._channels.get(key)
+            if ch is None:
+                raise MuxError(
+                    f"{self.label}: SDU for unknown protocol "
+                    f"{sdu.num}/{sdu.mode}")
+
+            def put(tx, ch=ch, data=sdu.payload):
+                buf = tx.read(ch.ingress)
+                if len(buf) + len(data) > ch.ingress_limit:
+                    raise MuxError(
+                        f"{self.label}: ingress overflow on {ch._num}")
+                tx.write(ch.ingress, buf + data)
+            await sim.atomically(put)
+
+
+class CodecChannel:
+    """Message-level channel over a byte stream + Codec: CBOR-prefix framing.
+
+    The Driver/Simple.hs byte-level driver analog: accumulates chunks and
+    decodes one CBOR item per message (mux SDU boundaries are invisible to
+    the protocol layer, as in the reference).
+    """
+
+    def __init__(self, byte_channel, codec):
+        self._ch = byte_channel
+        self._codec = codec
+        self._buf = b""
+
+    async def send(self, msg) -> None:
+        await self._ch.send(self._codec.encode(msg))
+
+    async def recv(self):
+        from ..utils import cbor
+        while True:
+            if self._buf:
+                try:
+                    _, used = cbor.loads_prefix(self._buf)
+                except cbor.CBORError as e:
+                    if "truncated" not in str(e):
+                        raise   # corrupt stream, not just a partial message
+                    used = 0
+                if used:
+                    raw, self._buf = self._buf[:used], self._buf[used:]
+                    return self._codec.decode(raw)
+            self._buf += await self._ch.recv()

@@ -1,0 +1,6 @@
+"""Mini-protocols: ChainSync, BlockFetch, TxSubmission, KeepAlive,
+Handshake, LocalStateQuery, LocalTxSubmission.
+
+Reference: ouroboros-network/src/Ouroboros/Network/Protocol/*/Type.hs state
+machines, rebuilt as ProtocolSpecs + message dataclasses + async peers.
+"""

@@ -1,0 +1,112 @@
+"""Handshake — version negotiation, the first protocol on every connection.
+
+Reference: ouroboros-network-framework/src/Ouroboros/Network/Protocol/
+Handshake/Type.hs:43-126 (StPropose/StConfirm; propose map -> accept or
+refuse) and Version.hs:19-86 (Versions map, acceptableVersion policy).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from ..typed import CLIENT, NOBODY, SERVER, ProtocolSpec
+from .codec import Codec
+
+
+@dataclass(frozen=True)
+class MsgProposeVersions:
+    TAG = 0
+    versions: tuple   # ((version_number, params_cbor), ...) ascending
+
+    def encode_args(self):
+        return [[[v, p] for v, p in self.versions]]
+
+    @classmethod
+    def decode_args(cls, a):
+        return cls(tuple((int(v), p) for v, p in a[0]))
+
+
+@dataclass(frozen=True)
+class MsgAcceptVersion:
+    TAG = 1
+    version: int
+    params: Any
+
+    def encode_args(self):
+        return [self.version, self.params]
+
+    @classmethod
+    def decode_args(cls, a):
+        return cls(int(a[0]), a[1])
+
+
+@dataclass(frozen=True)
+class MsgRefuse:
+    TAG = 2
+    reason: str
+
+    def encode_args(self):
+        return [self.reason]
+
+    @classmethod
+    def decode_args(cls, a):
+        return cls(str(a[0]))
+
+
+SPEC = ProtocolSpec(
+    name="handshake",
+    init_state="StPropose",
+    agency={"StPropose": CLIENT, "StConfirm": SERVER, "StDone": NOBODY},
+    transitions={
+        ("StPropose", "MsgProposeVersions"): "StConfirm",
+        ("StConfirm", "MsgAcceptVersion"): "StDone",
+        ("StConfirm", "MsgRefuse"): "StDone",
+    })
+
+CODEC = Codec([MsgProposeVersions, MsgAcceptVersion, MsgRefuse])
+
+
+class Versions:
+    """Map of version number -> (params, application); mirrors Version.hs."""
+
+    def __init__(self):
+        self._vs: dict[int, tuple] = {}
+
+    def add(self, number: int, params, application=None) -> "Versions":
+        self._vs[number] = (params, application)
+        return self
+
+    def numbers(self):
+        return sorted(self._vs)
+
+    def get(self, number: int):
+        return self._vs.get(number)
+
+
+def accept_highest_common(local: Versions, proposed) -> Optional[int]:
+    """Default acceptableVersion policy: highest common version number."""
+    proposed_numbers = {v for v, _ in proposed}
+    common = [v for v in local.numbers() if v in proposed_numbers]
+    return common[-1] if common else None
+
+
+async def client_propose(session, versions: Versions):
+    """Returns ("accepted", version, params) or ("refused", reason)."""
+    await session.send(MsgProposeVersions(
+        tuple((v, versions.get(v)[0]) for v in versions.numbers())))
+    reply = await session.recv()
+    if isinstance(reply, MsgRefuse):
+        return ("refused", reply.reason)
+    return ("accepted", reply.version, reply.params)
+
+
+async def server_accept(session, versions: Versions,
+                        policy: Callable = accept_highest_common):
+    msg = await session.recv()
+    chosen = policy(versions, msg.versions)
+    if chosen is None:
+        await session.send(MsgRefuse("no common version"))
+        return ("refused", "no common version")
+    params, _app = versions.get(chosen)
+    await session.send(MsgAcceptVersion(chosen, params))
+    return ("accepted", chosen, dict(msg.versions).get(chosen))

@@ -1,0 +1,93 @@
+"""KeepAlive — RTT probe + liveness.
+
+Reference: ouroboros-network/src/Ouroboros/Network/Protocol/KeepAlive/
+Type.hs:42-74 and KeepAlive.hs:41-55 (client loop feeding per-peer GSV
+DeltaQ state).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ... import simharness as sim
+from ..typed import CLIENT, NOBODY, SERVER, ProtocolSpec
+from .codec import Codec
+
+
+@dataclass(frozen=True)
+class MsgKeepAlive:
+    TAG = 0
+    cookie: int
+
+    def encode_args(self):
+        return [self.cookie]
+
+    @classmethod
+    def decode_args(cls, a):
+        return cls(int(a[0]))
+
+
+@dataclass(frozen=True)
+class MsgKeepAliveResponse:
+    TAG = 1
+    cookie: int
+
+    def encode_args(self):
+        return [self.cookie]
+
+    @classmethod
+    def decode_args(cls, a):
+        return cls(int(a[0]))
+
+
+@dataclass(frozen=True)
+class MsgDone:
+    TAG = 2
+
+    def encode_args(self):
+        return []
+
+    @classmethod
+    def decode_args(cls, a):
+        return cls()
+
+
+SPEC = ProtocolSpec(
+    name="keep-alive",
+    init_state="KAClient",
+    agency={"KAClient": CLIENT, "KAServer": SERVER, "KADone": NOBODY},
+    transitions={
+        ("KAClient", "MsgKeepAlive"): "KAServer",
+        ("KAServer", "MsgKeepAliveResponse"): "KAClient",
+        ("KAClient", "MsgDone"): "KADone",
+    })
+
+CODEC = Codec([MsgKeepAlive, MsgKeepAliveResponse, MsgDone])
+
+
+async def server(session):
+    while True:
+        msg = await session.recv()
+        if isinstance(msg, MsgDone):
+            return
+        await session.send(MsgKeepAliveResponse(msg.cookie))
+
+
+async def client_probe(session, rounds: int, interval: float,
+                       on_rtt=None):
+    """Probe loop: send cookie, measure virtual RTT, report to on_rtt
+    (the DeltaQ feed)."""
+    rtts = []
+    for cookie in range(rounds):
+        t0 = sim.now()
+        await session.send(MsgKeepAlive(cookie & 0xFFFF))
+        reply = await session.recv()
+        if reply.cookie != cookie & 0xFFFF:
+            raise RuntimeError("keep-alive cookie mismatch")
+        rtt = sim.now() - t0
+        rtts.append(rtt)
+        if on_rtt:
+            on_rtt(rtt)
+        if cookie != rounds - 1:
+            await sim.sleep(interval)
+    await session.send(MsgDone())
+    return rtts

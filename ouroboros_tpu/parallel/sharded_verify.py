@@ -22,6 +22,7 @@ from ..crypto import ed25519_jax as EJ
 from .mesh import WINDOW_AXIS
 
 
+@functools.lru_cache(maxsize=8)
 def build_sharded_verifier(mesh: Mesh):
     """Returns a jitted fn over sharded inputs:
     (yA, signA, yR, signR, s_bits, k_bits) -> (ok (N,), total_ok scalar).

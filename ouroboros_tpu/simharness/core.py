@@ -300,15 +300,17 @@ class Sim:
                     continue
                 self._step(thread)
         finally:
-            _current_sim = prev
             # Close coroutines of threads outliving the simulation so their
             # finally/__aexit__ blocks run and GC sees no un-awaited frames.
+            # Runs BEFORE restoring _current_sim (cleanup may use sim APIs);
+            # cleanup exceptions never replace the simulation's result.
             for t in self._threads.values():
                 if t.state not in (_DONE, _FAILED):
                     try:
                         t.coro.close()
-                    except RuntimeError:
-                        pass   # coroutine ignored GeneratorExit (awaited again)
+                    except BaseException as exc:  # noqa: BLE001
+                        self._ev(t, "cleanup-error", repr(exc))
+            _current_sim = prev
 
     def _step(self, thread: _Thread):
         # pending STM re-run takes priority (unless an exception is queued)

@@ -50,6 +50,14 @@ class TVar:
     def value(self) -> Any:
         return self._value
 
+    def set_notify(self, value: Any) -> None:
+        """Runtime-internal: write outside a transaction and wake STM
+        waiters.  For non-sim-thread producers (timer callbacks, registration
+        hooks); user code should write through atomically()."""
+        from . import core
+        self._value = value
+        core.current_sim().stm_notify([self._id])
+
     def __repr__(self):
         return f"<TVar {self._id}{' ' + self.label if self.label else ''}={self._value!r}>"
 

@@ -1,0 +1,1 @@
+"""utils — CBOR, resource registry, misc support."""

@@ -1,0 +1,185 @@
+"""Minimal CBOR (RFC 8949) encoder/decoder.
+
+The reference serialises every protocol message and ledger snapshot as CBOR
+(codecs under Protocol/*/Codec.hs; snapshots in Storage/LedgerDB/OnDisk.hs).
+This is a compact self-contained implementation covering the subset those
+formats need: uints/nints, byte/text strings, arrays, maps, tags, simple
+values, floats, and indefinite-length arrays.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+__all__ = ["dumps", "loads", "CBORError", "Tag"]
+
+
+class CBORError(ValueError):
+    pass
+
+
+class Tag:
+    __slots__ = ("tag", "value")
+
+    def __init__(self, tag: int, value: Any):
+        self.tag = tag
+        self.value = value
+
+    def __eq__(self, other):
+        return (isinstance(other, Tag) and self.tag == other.tag
+                and self.value == other.value)
+
+    def __repr__(self):
+        return f"Tag({self.tag}, {self.value!r})"
+
+
+def _head(major: int, arg: int) -> bytes:
+    if arg < 24:
+        return bytes([(major << 5) | arg])
+    if arg < 256:
+        return bytes([(major << 5) | 24, arg])
+    if arg < 65536:
+        return bytes([(major << 5) | 25]) + arg.to_bytes(2, "big")
+    if arg < 2**32:
+        return bytes([(major << 5) | 26]) + arg.to_bytes(4, "big")
+    if arg < 2**64:
+        return bytes([(major << 5) | 27]) + arg.to_bytes(8, "big")
+    raise CBORError("integer too large for CBOR head")
+
+
+def _encode(obj: Any, out: bytearray) -> None:
+    if obj is None:
+        out.append(0xF6)
+    elif obj is True:
+        out.append(0xF5)
+    elif obj is False:
+        out.append(0xF4)
+    elif isinstance(obj, int):
+        if obj >= 0:
+            out += _head(0, obj)
+        else:
+            out += _head(1, -1 - obj)
+    elif isinstance(obj, bytes):
+        out += _head(2, len(obj))
+        out += obj
+    elif isinstance(obj, str):
+        raw = obj.encode("utf-8")
+        out += _head(3, len(raw))
+        out += raw
+    elif isinstance(obj, (list, tuple)):
+        out += _head(4, len(obj))
+        for item in obj:
+            _encode(item, out)
+    elif isinstance(obj, dict):
+        out += _head(5, len(obj))
+        for k, v in obj.items():
+            _encode(k, out)
+            _encode(v, out)
+    elif isinstance(obj, Tag):
+        out += _head(6, obj.tag)
+        _encode(obj.value, out)
+    elif isinstance(obj, float):
+        out.append(0xFB)
+        out += struct.pack(">d", obj)
+    else:
+        raise CBORError(f"cannot CBOR-encode {type(obj).__name__}")
+
+
+def dumps(obj: Any) -> bytes:
+    out = bytearray()
+    _encode(obj, out)
+    return bytes(out)
+
+
+class _Decoder:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self.pos + n > len(self.data):
+            raise CBORError("truncated CBOR")
+        b = self.data[self.pos:self.pos + n]
+        self.pos += n
+        return b
+
+    def _arg(self, info: int) -> int:
+        if info < 24:
+            return info
+        if info == 24:
+            return self._take(1)[0]
+        if info == 25:
+            return int.from_bytes(self._take(2), "big")
+        if info == 26:
+            return int.from_bytes(self._take(4), "big")
+        if info == 27:
+            return int.from_bytes(self._take(8), "big")
+        raise CBORError(f"unsupported additional info {info}")
+
+    def decode(self) -> Any:
+        b = self._take(1)[0]
+        major, info = b >> 5, b & 0x1F
+        if major == 0:
+            return self._arg(info)
+        if major == 1:
+            return -1 - self._arg(info)
+        if major == 2:
+            return bytes(self._take(self._arg(info)))
+        if major == 3:
+            return self._take(self._arg(info)).decode("utf-8")
+        if major == 4:
+            if info == 31:                     # indefinite-length array
+                items = []
+                while True:
+                    if self.data[self.pos:self.pos + 1] == b"\xff":
+                        self.pos += 1
+                        return items
+                    items.append(self.decode())
+            return [self.decode() for _ in range(self._arg(info))]
+        if major == 5:
+            n = self._arg(info)
+            return {self.decode(): self.decode() for _ in range(n)}
+        if major == 6:
+            return Tag(self._arg(info), self.decode())
+        # major 7
+        if info == 20:
+            return False
+        if info == 21:
+            return True
+        if info == 22 or info == 23:
+            return None
+        if info == 25:
+            # half float
+            h = int.from_bytes(self._take(2), "big")
+            return _decode_half(h)
+        if info == 26:
+            return struct.unpack(">f", self._take(4))[0]
+        if info == 27:
+            return struct.unpack(">d", self._take(8))[0]
+        raise CBORError(f"unsupported simple value {info}")
+
+
+def _decode_half(h: int) -> float:
+    sign = -1.0 if h & 0x8000 else 1.0
+    exp = (h >> 10) & 0x1F
+    frac = h & 0x3FF
+    if exp == 0:
+        return sign * frac * 2.0 ** -24
+    if exp == 31:
+        return sign * (float("inf") if frac == 0 else float("nan"))
+    return sign * (1 + frac / 1024.0) * 2.0 ** (exp - 15)
+
+
+def loads(data: bytes, allow_trailing: bool = False):
+    dec = _Decoder(data)
+    obj = dec.decode()
+    if not allow_trailing and dec.pos != len(data):
+        raise CBORError(f"trailing bytes after CBOR value at {dec.pos}")
+    return obj
+
+
+def loads_prefix(data: bytes) -> tuple[Any, int]:
+    """Decode one CBOR item, returning (value, bytes_consumed)."""
+    dec = _Decoder(data)
+    obj = dec.decode()
+    return obj, dec.pos

@@ -1,0 +1,316 @@
+"""Mini-protocol tests: codec round-trips, direct client<->server runs in
+the sim, agency enforcement (reference: protocol-tests/ per protocol —
+codec props + Direct.hs props, SURVEY.md §4.4)."""
+import pytest
+
+from ouroboros_tpu import simharness as sim
+from ouroboros_tpu.chain import (Chain, ChainProducerState, Point, Tip,
+                                 AnchoredFragment, make_block, point_of)
+from ouroboros_tpu.network import typed
+from ouroboros_tpu.network.channel import channel_pair
+from ouroboros_tpu.network.protocols import (
+    blockfetch, chainsync, handshake, keepalive, localstatequery,
+    localtxsubmission, txsubmission,
+)
+from ouroboros_tpu.network.protocols.codec import roundtrip_property
+from ouroboros_tpu.network.typed import CLIENT, SERVER, ProtocolError, run_peer
+
+
+def mk_blocks(n, seed=b""):
+    out, prev = [], None
+    for i in range(n):
+        prev = make_block(prev, i * 2 + 1, body=[seed + b"tx%d" % i])
+        out.append(prev)
+    return out
+
+
+def test_codec_roundtrips_all_protocols():
+    blocks = mk_blocks(2)
+    tip = Tip(point_of(blocks[-1]), blocks[-1].block_no)
+    p = point_of(blocks[0])
+    cases = [
+        (chainsync.CODEC, [
+            chainsync.MsgRequestNext(), chainsync.MsgAwaitReply(),
+            chainsync.MsgRollForward(blocks[0].header, tip),
+            chainsync.MsgRollBackward(p, tip),
+            chainsync.MsgFindIntersect((p, Point.genesis())),
+            chainsync.MsgIntersectFound(p, tip),
+            chainsync.MsgIntersectNotFound(tip), chainsync.MsgDone()]),
+        (blockfetch.CODEC, [
+            blockfetch.MsgRequestRange(p, point_of(blocks[1])),
+            blockfetch.MsgClientDone(), blockfetch.MsgStartBatch(),
+            blockfetch.MsgNoBlocks(), blockfetch.MsgBlock(blocks[0]),
+            blockfetch.MsgBatchDone()]),
+        (txsubmission.CODEC, [
+            txsubmission.MsgRequestTxIds(True, 3, 5),
+            txsubmission.MsgReplyTxIds(((b"id1", 100), (b"id2", 200))),
+            txsubmission.MsgRequestTxs((b"id1",)),
+            txsubmission.MsgReplyTxs((b"txbytes",)),
+            txsubmission.MsgDone()]),
+        (keepalive.CODEC, [
+            keepalive.MsgKeepAlive(77), keepalive.MsgKeepAliveResponse(77),
+            keepalive.MsgDone()]),
+        (handshake.CODEC, [
+            handshake.MsgProposeVersions(((7, {"net": 42}), (8, None))),
+            handshake.MsgAcceptVersion(8, {"net": 42}),
+            handshake.MsgRefuse("nope")]),
+        (localstatequery.CODEC, [
+            localstatequery.MsgAcquire(p), localstatequery.MsgAcquire(None),
+            localstatequery.MsgAcquired(), localstatequery.MsgFailure("x"),
+            localstatequery.MsgQuery(["get", "tip"]),
+            localstatequery.MsgResult([1, 2]),
+            localstatequery.MsgReAcquire(None), localstatequery.MsgRelease(),
+            localstatequery.MsgDone()]),
+        (localtxsubmission.CODEC, [
+            localtxsubmission.MsgSubmitTx(b"tx"),
+            localtxsubmission.MsgAcceptTx(),
+            localtxsubmission.MsgRejectTx("bad"),
+            localtxsubmission.MsgDone()]),
+    ]
+    for codec, msgs in cases:
+        assert roundtrip_property(codec, msgs)
+
+
+def test_chainsync_direct_sync():
+    blocks = mk_blocks(12)
+
+    async def main():
+        ps = ChainProducerState()
+        for b in blocks:
+            ps.add_block(b)
+        fid = ps.new_follower()
+        frag = AnchoredFragment.from_genesis()
+
+        async def client(s):
+            return await chainsync.client_sync_to_tip(
+                s, [Point.genesis()], frag)
+
+        async def server(s):
+            return await chainsync.server_from_producer(s, ps, fid)
+
+        return await typed.connect(chainsync.SPEC, client, server)
+
+    sim.run(main())
+    # client fragment should now hold all headers
+
+
+def test_chainsync_client_follows_headers():
+    blocks = mk_blocks(12)
+
+    async def main():
+        ps = ChainProducerState()
+        for b in blocks:
+            ps.add_block(b)
+        fid = ps.new_follower()
+        frag = AnchoredFragment.from_genesis()
+
+        async def client(s):
+            return await chainsync.client_sync_to_tip(
+                s, [Point.genesis()], frag)
+
+        await typed.connect(chainsync.SPEC, client,
+                            lambda s: chainsync.server_from_producer(s, ps, fid))
+        return [h.hash for h in frag]
+
+    got = sim.run(main())
+    assert got == [b.header.hash for b in blocks]
+
+
+def test_blockfetch_direct():
+    blocks = mk_blocks(8)
+    index = {b.hash: i for i, b in enumerate(blocks)}
+
+    def lookup_range(start, end):
+        i, j = index.get(start.hash), index.get(end.hash)
+        if i is None or j is None or j < i:
+            return None
+        return blocks[i:j + 1]
+
+    async def main():
+        async def client(s):
+            got = await blockfetch.fetch_range(
+                s, point_of(blocks[2]), point_of(blocks[5]))
+            missing = await blockfetch.fetch_range(
+                s, Point(999, b"\x42" * 32), point_of(blocks[5]))
+            await s.send(blockfetch.MsgClientDone())
+            return got, missing
+
+        return (await typed.connect(
+            blockfetch.SPEC, client,
+            lambda s: blockfetch.server_from_blocks(s, lookup_range)))[0]
+
+    got, missing = sim.run(main())
+    assert got == blocks[2:6]
+    assert missing is None
+
+
+def test_txsubmission_relay():
+    class Reader:
+        def __init__(self, txs):
+            self.txs = list(txs)          # [(id, bytes)]
+            self.cursor = 0
+
+        def next_ids(self, n):
+            out = [(i, len(t)) for i, t in
+                   self.txs[self.cursor:self.cursor + n]]
+            self.cursor += len(out)
+            return out
+
+        def lookup(self, txid):
+            return dict(self.txs).get(txid)
+
+    txs = [(b"id%d" % i, b"tx-payload-%d" % i) for i in range(25)]
+    got = {}
+
+    async def main():
+        reader = Reader(txs)
+
+        async def outbound(s):   # CLIENT role (the mempool holder)
+            return await txsubmission.outbound_from_mempool(s, reader)
+
+        async def inbound(s):    # SERVER role (the requester)
+            return await txsubmission.inbound_collect(
+                s, lambda t: got.__setitem__(t.split(b"-")[-1], t), window=7)
+
+        return await typed.connect(txsubmission.SPEC, outbound, inbound)
+
+    sim.run(main())
+    assert sorted(got.values()) == sorted(t for _, t in txs)
+
+
+def test_keepalive_rtt_measured():
+    async def main():
+        async def client(s):
+            return await keepalive.client_probe(s, rounds=5, interval=1.0)
+
+        (rtts, _) = await typed.connect(keepalive.SPEC, client,
+                                        keepalive.server, delay=0.25)
+        return rtts
+
+    rtts = sim.run(main())
+    assert len(rtts) == 5
+    assert all(abs(r - 0.5) < 1e-9 for r in rtts)   # 2 x 0.25s channel delay
+
+
+def test_handshake_negotiation():
+    async def main():
+        client_vs = handshake.Versions().add(6, {"m": 1}).add(7, {"m": 1})
+        server_vs = handshake.Versions().add(5, {"m": 1}).add(7, {"m": 1}) \
+                                        .add(9, {"m": 1})
+        return await typed.connect(
+            handshake.SPEC,
+            lambda s: handshake.client_propose(s, client_vs),
+            lambda s: handshake.server_accept(s, server_vs))
+
+    cres, sres = sim.run(main())
+    assert cres[0] == "accepted" and cres[1] == 7
+    assert sres[0] == "accepted" and sres[1] == 7
+
+
+def test_handshake_no_common_version():
+    async def main():
+        return await typed.connect(
+            handshake.SPEC,
+            lambda s: handshake.client_propose(
+                s, handshake.Versions().add(1, None)),
+            lambda s: handshake.server_accept(
+                s, handshake.Versions().add(2, None)))
+
+    cres, sres = sim.run(main())
+    assert cres == ("refused", "no common version")
+
+
+def test_localstatequery_acquire_query():
+    async def main():
+        state_data = {"tip": [5, b"h"], "balance": 100}
+
+        def acquire(point):
+            return state_data
+
+        def answer(state, q):
+            return state.get(q)
+
+        async def client(s):
+            return await localstatequery.query_once(s, "balance")
+
+        return (await typed.connect(
+            localstatequery.SPEC, client,
+            lambda s: localstatequery.server(s, acquire, answer)))[0]
+
+    assert sim.run(main()) == 100
+
+
+def test_localtxsubmission_accept_reject():
+    async def main():
+        seen = []
+
+        def try_add(tx):
+            seen.append(tx)
+            return None if len(tx) < 10 else "too big"
+
+        async def client(s):
+            return await localtxsubmission.submit(
+                s, [b"small", b"x" * 20, b"ok"])
+
+        return (await typed.connect(
+            localtxsubmission.SPEC, client,
+            lambda s: localtxsubmission.server(s, try_add)))[0]
+
+    assert sim.run(main()) == [None, "too big", None]
+
+
+def test_agency_violation_detected():
+    async def main():
+        ca, cb = channel_pair(label="bad")
+
+        async def bad_client(s):
+            # server-only message sent by client
+            await s.send(chainsync.MsgRollForward(
+                mk_blocks(1)[0].header, Tip.genesis()))
+
+        h = sim.spawn(run_peer(chainsync.SPEC, CLIENT, ca, bad_client))
+        try:
+            await h.wait()
+        except ProtocolError as e:
+            return str(e)
+        return None
+
+    err = sim.run(main())
+    assert err is not None and "not allowed" in err
+
+
+def test_pipelined_chainsync_requests():
+    """Pipelined client: issue several MsgRequestNext before collecting."""
+    blocks = mk_blocks(6)
+
+    async def main():
+        ps = ChainProducerState()
+        for b in blocks:
+            ps.add_block(b)
+        fid = ps.new_follower()
+        ca, cb = channel_pair(label="pcs")
+
+        async def client(s):
+            # consume initial rollback instruction via pipeline too
+            for _ in range(4):
+                await s.send_pipelined(chainsync.MsgRequestNext(),
+                                       reply_state="StIdle")
+            got = []
+            for _ in range(4):
+                got.append(await s.collect())
+            await s.send(chainsync.MsgDone())
+            return got
+
+        ch = sim.spawn(run_peer(chainsync.SPEC, CLIENT, ca, client,
+                                pipelined=True))
+        sh = sim.spawn(run_peer(
+            chainsync.SPEC, SERVER, cb,
+            lambda s: chainsync.server_from_producer(s, ps, fid)))
+        got = await ch.wait()
+        await sh.wait()
+        return got
+
+    got = sim.run(main())
+    assert isinstance(got[0], chainsync.MsgRollBackward)
+    assert [m.header.hash for m in got[1:]] == \
+        [b.header.hash for b in blocks[:3]]
