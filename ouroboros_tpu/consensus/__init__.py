@@ -1,0 +1,27 @@
+"""Consensus core — protocol abstraction, header/ledger validation, batching.
+
+Rebuilds the seams of /root/reference/ouroboros-consensus (SURVEY.md §2 L5)
+TPU-first: the `ConsensusProtocol` class (Protocol/Abstract.hs:50) grows an
+explicit proof-extraction hook so that a *window* of headers can have its
+VRF/KES/Ed25519 proofs verified as one device batch (the reference verifies
+strictly sequentially — SURVEY.md §2 "The TPU-relevant gap").
+"""
+from .protocol import ConsensusProtocol, NullProtocol
+from .header_validation import (
+    HeaderError, HeaderState, HeaderStateHistory, validate_header,
+    revalidate_header,
+)
+from .ledger import (
+    LedgerError, LedgerRules, ExtLedgerState, ExtLedgerRules,
+    OutsideForecastRange,
+)
+from .batch import validate_headers_batched, BatchValidationResult
+
+__all__ = [
+    "ConsensusProtocol", "NullProtocol",
+    "HeaderError", "HeaderState", "HeaderStateHistory", "validate_header",
+    "revalidate_header",
+    "LedgerError", "LedgerRules", "ExtLedgerState", "ExtLedgerRules",
+    "OutsideForecastRange",
+    "validate_headers_batched", "BatchValidationResult",
+]

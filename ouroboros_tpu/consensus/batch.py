@@ -1,0 +1,161 @@
+"""Batched window validation — the point of the framework.
+
+The reference validates strictly sequentially (`ledgerDbPushMany` fold,
+LedgerDB/InMemory.hs:429-449; per-header validate in the ChainSync client,
+MiniProtocol/ChainSync/Client.hs:792).  Per SURVEY.md §2 "The TPU-relevant
+gap", every VRF/KES/Ed25519 proof in a window of headers/blocks is
+*independent* once the cheap sequential inputs (nonces, ticked states) are
+derived.  This module does the split:
+
+  pass 1 (host, sequential, cheap)  envelope checks + tick + reupdate fold,
+                                    collecting proof obligations per header
+  pass 2 (device, one batch)        all proofs verified together
+  result                            valid prefix + states, or first failure
+
+This is the `lax.scan` (sequential state) + vmapped-verify (parallel proofs)
+decomposition of SURVEY.md §7 P3, with the scan on host because chain state
+is pointer-heavy, and the FLOP-heavy group math on device.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence
+
+from ..crypto.backend import CryptoBackend, default_backend
+from .header_validation import (
+    HeaderError, HeaderState, validate_envelope, revalidate_header,
+)
+from .ledger import ExtLedgerRules, ExtLedgerState, LedgerError
+from .protocol import ConsensusProtocol, _verify_mixed
+
+
+@dataclass
+class BatchValidationResult:
+    """Valid prefix of the window.
+
+    states[i] is the state *after* headers[i]; len(states) == n_valid.
+    error explains why headers[n_valid] failed (None if all valid).
+    """
+    states: list
+    n_valid: int
+    error: Optional[Exception]
+
+    @property
+    def all_valid(self) -> bool:
+        return self.error is None
+
+    @property
+    def final_state(self):
+        return self.states[-1] if self.states else None
+
+
+def validate_headers_batched(
+        protocol: ConsensusProtocol,
+        headers: Sequence[Any],
+        header_state: HeaderState,
+        ledger_view_for: Callable[[int, Any], Any],
+        backend: Optional[CryptoBackend] = None) -> BatchValidationResult:
+    """Validate a window of headers with one device batch for all proofs.
+
+    Equivalent to folding validate_header, but ~window-size× fewer device
+    round trips.  `ledger_view_for(i, header)` supplies the ledger view for
+    header i (from forecasts during sync, or the tip view during replay).
+    """
+    backend = backend or default_backend()
+    states: list[HeaderState] = []
+    proofs: list = []
+    owner: list[int] = []          # proofs[j] belongs to headers[owner[j]]
+    seq_error: Optional[Exception] = None
+    n_seq = 0                      # headers that passed the sequential pass
+
+    st = header_state
+    for i, h in enumerate(headers):
+        view = ledger_view_for(i, h)
+        try:
+            validate_envelope(h, st)
+            ticked = protocol.tick_chain_dep_state(
+                st.chain_dep_state, view, h.slot)
+            protocol.sequential_checks(ticked, h, view)
+            reqs = protocol.extract_proofs(ticked, h, view)
+            st = revalidate_header(protocol, view, h, st)
+        except Exception as e:
+            seq_error = e if isinstance(e, HeaderError) else HeaderError(str(e))
+            break
+        proofs.extend(reqs)
+        owner.extend([i] * len(reqs))
+        states.append(st)
+        n_seq += 1
+
+    # one device batch for every proof in the window
+    ok = _verify_mixed(backend, proofs) if proofs else []
+    first_bad = n_seq
+    bad_proof: Optional[int] = None
+    for j, good in enumerate(ok):
+        if not good and owner[j] < first_bad:
+            first_bad, bad_proof = owner[j], j
+
+    if bad_proof is not None:
+        err: Optional[Exception] = HeaderError(
+            f"proof {type(proofs[bad_proof]).__name__} failed for header "
+            f"index {first_bad} (slot {headers[first_bad].slot})")
+    else:
+        err = seq_error
+    return BatchValidationResult(states[:first_bad], first_bad, err)
+
+
+def validate_blocks_batched(
+        ext_rules: ExtLedgerRules,
+        blocks: Sequence[Any],
+        ext_state: ExtLedgerState,
+        backend: Optional[CryptoBackend] = None) -> BatchValidationResult:
+    """Full-block analog: header proofs + body witness proofs (the
+    reference's BBODY Ed25519 multi-verify) in one batch.  The replay/
+    candidate-validation hot path (ChainSel.hs:775-808, OnDisk.hs:277),
+    batched."""
+    backend = backend or default_backend()
+    protocol, ledger = ext_rules.protocol, ext_rules.ledger
+    states: list[ExtLedgerState] = []
+    proofs: list = []
+    owner: list[int] = []
+    seq_error: Optional[Exception] = None
+    n_seq = 0
+
+    st = ext_state
+    for i, b in enumerate(blocks):
+        header = getattr(b, "header", b)
+        view = ledger.ledger_view(st.ledger)
+        try:
+            validate_envelope(header, st.header)
+            ticked_dep = protocol.tick_chain_dep_state(
+                st.header.chain_dep_state, view, header.slot)
+            protocol.sequential_checks(ticked_dep, header, view)
+            ticked_ledger = ledger.tick(st.ledger, b.slot)
+            ledger.sequential_checks(ticked_ledger, b)
+            reqs = (protocol.extract_proofs(ticked_dep, header, view)
+                    + ledger.extract_proofs(ticked_ledger, b))
+            st = ExtLedgerState(
+                ledger.reapply_block(ticked_ledger, b),
+                revalidate_header(protocol, view, header, st.header))
+        except Exception as e:
+            seq_error = (e if isinstance(e, (HeaderError, LedgerError))
+                         else LedgerError(str(e)))
+            break
+        proofs.extend(reqs)
+        owner.extend([i] * len(reqs))
+        states.append(st)
+        n_seq += 1
+
+    ok = _verify_mixed(backend, proofs) if proofs else []
+    first_bad = n_seq
+    bad_proof = None
+    for j, good in enumerate(ok):
+        if not good and owner[j] < first_bad:
+            first_bad, bad_proof = owner[j], j
+
+    if bad_proof is not None:
+        err: Optional[Exception] = LedgerError(
+            f"proof {type(proofs[bad_proof]).__name__} failed for block "
+            f"index {first_bad} (slot {blocks[first_bad].slot})")
+    else:
+        err = seq_error
+    return BatchValidationResult(states[:first_bad], first_bad, err)

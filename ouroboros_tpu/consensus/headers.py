@@ -1,0 +1,120 @@
+"""Protocol-carrying header: concrete header + named protocol fields.
+
+The reference attaches protocol evidence to headers via per-era header types
+(e.g. mock Praos' `PraosFields` with VRF certs + KES signature,
+ouroboros-consensus-mock/src/Ouroboros/Consensus/Mock/Protocol/Praos.hs;
+BFT's `BftFields` DSIGN signature, Protocol/BFT.hs).  Here one generic
+header type carries an ordered tuple of (name, value) protocol fields;
+signatures cover the CBOR encoding with the signature fields dropped
+(`bytes_dropping`), matching the reference's sign-the-header-minus-signature
+convention.
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field, replace
+from typing import Any, Optional, Sequence
+
+from ..chain.block import GENESIS_HASH
+from ..utils import cbor
+
+
+@dataclass(frozen=True)
+class ProtocolHeader:
+    """HasHeader + protocol evidence fields."""
+    slot: int
+    block_no: int
+    prev_hash: bytes
+    body_hash: bytes
+    issuer: int = 0                     # index into the ledger view's keys
+    fields: tuple = ()                  # ((name, bytes-or-int), ...)
+
+    _cache: dict = field(default_factory=dict, repr=False, hash=False,
+                         compare=False)
+
+    def encode(self, drop: Sequence[str] = ()):
+        fs = [[k, v] for k, v in self.fields if k not in drop]
+        return [self.slot, self.block_no, self.prev_hash, self.body_hash,
+                self.issuer, fs]
+
+    @classmethod
+    def decode(cls, obj) -> "ProtocolHeader":
+        fs = tuple((str(k) if isinstance(k, str) else bytes(k).decode(),
+                    bytes(v) if isinstance(v, (bytes, bytearray)) else int(v))
+                   for k, v in obj[5])
+        return cls(int(obj[0]), int(obj[1]), bytes(obj[2]), bytes(obj[3]),
+                   int(obj[4]), fs)
+
+    def bytes_dropping(self, *drop: str) -> bytes:
+        """Serialisation with the named fields removed — what gets signed."""
+        return cbor.dumps(self.encode(drop))
+
+    @property
+    def bytes(self) -> bytes:
+        return cbor.dumps(self.encode())
+
+    @property
+    def hash(self) -> bytes:
+        c = self._cache
+        if "h" not in c:
+            c["h"] = hashlib.blake2b(self.bytes, digest_size=32).digest()
+        return c["h"]
+
+    def get(self, name: str, default: Any = None) -> Any:
+        for k, v in self.fields:
+            if k == name:
+                return v
+        return default
+
+    def with_fields(self, **kw) -> "ProtocolHeader":
+        merged = dict(self.fields)
+        merged.update(kw)
+        return replace(self, fields=tuple(sorted(merged.items())),
+                       _cache={})
+
+
+@dataclass(frozen=True)
+class ProtocolBlock:
+    """Block = protocol header + opaque tx body tuple."""
+    header: ProtocolHeader
+    body: tuple = ()
+
+    @property
+    def slot(self) -> int:
+        return self.header.slot
+
+    @property
+    def block_no(self) -> int:
+        return self.header.block_no
+
+    @property
+    def hash(self) -> bytes:
+        return self.header.hash
+
+    @property
+    def prev_hash(self) -> bytes:
+        return self.header.prev_hash
+
+    def encode(self):
+        return [self.header.encode(), [t.encode() if hasattr(t, "encode")
+                                       else t for t in self.body]]
+
+    @property
+    def bytes(self) -> bytes:
+        return cbor.dumps(self.encode())
+
+
+def body_hash_of(body: Sequence) -> bytes:
+    enc = [t.encode() if hasattr(t, "encode") else t for t in body]
+    return hashlib.blake2b(cbor.dumps(enc), digest_size=32).digest()
+
+
+def make_header(prev: Optional[ProtocolHeader], slot: int, body: Sequence,
+                issuer: int) -> ProtocolHeader:
+    """Unsigned header extending `prev`; protocols add evidence fields."""
+    if prev is None:
+        prev_hash, block_no = GENESIS_HASH, 0
+    else:
+        prev_hash, block_no = prev.hash, prev.block_no + 1
+    return ProtocolHeader(slot=slot, block_no=block_no, prev_hash=prev_hash,
+                          body_hash=body_hash_of(body), issuer=issuer)

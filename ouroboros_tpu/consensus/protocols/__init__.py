@@ -1,0 +1,17 @@
+"""Protocol instantiations: BFT, PBFT, mock Praos.
+
+Reference: ouroboros-consensus/src/Ouroboros/Consensus/Protocol/{BFT,PBFT}.hs
+and ouroboros-consensus-mock/src/Ouroboros/Consensus/Mock/Protocol/Praos.hs.
+"""
+from .bft import Bft, bft_sign_header
+from .pbft import PBft, pbft_sign_header
+from .praos import (
+    Praos, PraosConfig, PraosNode, PraosState, HotKey, praos_forge_fields,
+)
+
+__all__ = [
+    "Bft", "bft_sign_header",
+    "PBft", "pbft_sign_header",
+    "Praos", "PraosConfig", "PraosNode", "PraosState", "HotKey",
+    "praos_forge_fields",
+]

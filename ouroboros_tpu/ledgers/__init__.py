@@ -1,0 +1,4 @@
+"""Ledger instantiations (the ouroboros-consensus-{mock,shelley,...} analog)."""
+from .mock import MockLedger, MockLedgerState, Tx, TxIn, TxOut, make_tx
+
+__all__ = ["MockLedger", "MockLedgerState", "Tx", "TxIn", "TxOut", "make_tx"]

@@ -1,0 +1,198 @@
+"""BFT / PBFT / mock-Praos protocol instantiations.
+
+Reference test surface: ouroboros-consensus tests for BFT/PBFT and
+ouroboros-consensus-mock-test ThreadNet leader-schedule properties
+(SURVEY.md §4.1); here: leadership schedules, threshold enforcement,
+KES/VRF header evidence round-trips, batch-vs-sequential agreement.
+"""
+import hashlib
+
+import pytest
+
+from ouroboros_tpu.consensus import (
+    HeaderState, validate_header, HeaderError, validate_headers_batched,
+)
+from ouroboros_tpu.consensus.headers import make_header
+from ouroboros_tpu.consensus.protocol import ProtocolError
+from ouroboros_tpu.consensus.protocols import (
+    Bft, PBft, Praos, PraosConfig, PraosNode, HotKey,
+    bft_sign_header, pbft_sign_header, praos_forge_fields,
+)
+from ouroboros_tpu.crypto import ed25519_ref, kes as kes_mod, vrf_ref
+from ouroboros_tpu.crypto.backend import OpensslBackend
+
+BACKEND = OpensslBackend()
+
+
+def _keys(n, tag=b"node"):
+    sks = [hashlib.sha256(tag + b"-%d" % i).digest() for i in range(n)]
+    return sks, [ed25519_ref.public_key(sk) for sk in sks]
+
+
+class TestBftLeadership:
+    def test_round_robin(self):
+        _, vks = _keys(3)
+        p = Bft(vks)
+        for slot in range(9):
+            for idx in range(3):
+                lead = p.check_is_leader(idx, slot, (), None)
+                assert (lead is not None) == (slot % 3 == idx)
+
+
+class TestPBft:
+    def _chain(self, p, sks, issuers, start_slot=0):
+        headers, prev = [], None
+        for j, issuer in enumerate(issuers):
+            h = make_header(prev, start_slot + j, (), issuer=issuer)
+            h = pbft_sign_header(sks[issuer], h)
+            headers.append(h)
+            prev = h
+        return headers
+
+    def test_threshold_violation(self):
+        sks, vks = _keys(4)
+        # window 10, threshold 0.25 -> limit = 2 sigs per signer per window
+        p = PBft(vks, threshold=0.25, window=10, k=5)
+        ok_headers = self._chain(p, sks, [0, 1, 0, 2, 0])  # node0 signs 3 > 2
+        st = HeaderState.genesis(p)
+        st = validate_header(p, None, ok_headers[0], st, backend=BACKEND)
+        st = validate_header(p, None, ok_headers[1], st, backend=BACKEND)
+        st = validate_header(p, None, ok_headers[2], st, backend=BACKEND)
+        st = validate_header(p, None, ok_headers[3], st, backend=BACKEND)
+        with pytest.raises(HeaderError):
+            validate_header(p, None, ok_headers[4], st, backend=BACKEND)
+
+    def test_window_slides(self):
+        sks, vks = _keys(2)
+        p = PBft(vks, threshold=0.5, window=4, k=5)
+        # alternating signers never violate a 0.5 threshold
+        headers = self._chain(p, sks, [0, 1, 0, 1, 0, 1, 0, 1])
+        res = validate_headers_batched(
+            p, headers, HeaderState.genesis(p), lambda i, h: None,
+            backend=BACKEND)
+        assert res.all_valid
+
+    def test_non_delegate_rejected(self):
+        sks, vks = _keys(2)
+        p = PBft(vks, k=5)
+        h = make_header(None, 0, (), issuer=7)
+        h = pbft_sign_header(sks[0], h)
+        with pytest.raises(HeaderError):
+            validate_header(p, None, h, HeaderState.genesis(p),
+                            backend=BACKEND)
+
+
+def _praos_setup(n=3, **cfg_kw):
+    vrf_sks = [hashlib.sha256(b"vrf-%d" % i).digest() for i in range(n)]
+    # ECVRF-ed25519 keys share ed25519's vk derivation (vk = [x]B)
+    vrf_vks = [ed25519_ref.public_key(sk) for sk in vrf_sks]
+    kes_keys = [kes_mod.KesSignKey(cfg_kw.get("kes_depth", 3),
+                                   hashlib.sha256(b"kes-%d" % i).digest())
+                for i in range(n)]
+    cfg = PraosConfig(
+        nodes=tuple(PraosNode(vrf_vk=vrf_vks[i],
+                              kes_vk=kes_keys[i].verification_key, stake=1)
+                    for i in range(n)),
+        k=5, f=0.9, epoch_length=10, kes_depth=cfg_kw.get("kes_depth", 3),
+        slots_per_kes_period=cfg_kw.get("slots_per_kes_period", 5))
+    return cfg, vrf_sks, [HotKey(k) for k in kes_keys]
+
+
+def _praos_forge_chain(protocol, vrf_sks, hot_keys, n_slots):
+    """Forge a chain by letting every node try each slot (mock ThreadNet)."""
+    headers, prev = [], None
+    st = protocol.initial_chain_dep_state()
+    for slot in range(n_slots):
+        ticked = protocol.tick_chain_dep_state(st, None, slot)
+        for idx in range(len(protocol.config.nodes)):
+            pi = protocol.check_is_leader((idx, vrf_sks[idx]), slot, ticked,
+                                          None)
+            if pi is None:
+                continue
+            h = make_header(prev, slot, (), issuer=idx)
+            h = praos_forge_fields(protocol, hot_keys[idx], pi, h)
+            headers.append(h)
+            prev = h
+            st = protocol.reupdate_chain_dep_state(ticked, h, None)
+            break
+    return headers
+
+
+class TestPraos:
+    def test_forge_and_validate_chain(self):
+        cfg, vrf_sks, hot_keys = _praos_setup()
+        p = Praos(cfg)
+        headers = _praos_forge_chain(p, vrf_sks, hot_keys, 25)
+        assert len(headers) >= 5     # f=0.9, 3 nodes: most slots have a leader
+        st = HeaderState.genesis(p)
+        for h in headers:
+            st = validate_header(p, None, h, st, backend=BACKEND)
+        assert st.tip.block_no == len(headers) - 1
+        # crossed at least one epoch boundary and evolved the nonce
+        assert st.chain_dep_state.epoch >= 1
+
+    def test_batched_matches_sequential(self):
+        cfg, vrf_sks, hot_keys = _praos_setup()
+        p = Praos(cfg)
+        headers = _praos_forge_chain(p, vrf_sks, hot_keys, 25)
+        res = validate_headers_batched(
+            p, headers, HeaderState.genesis(p), lambda i, h: None,
+            backend=BACKEND)
+        assert res.all_valid and res.n_valid == len(headers)
+        st = HeaderState.genesis(p)
+        for h in headers:
+            st = validate_header(p, None, h, st, backend=BACKEND)
+        assert res.final_state == st
+
+    def test_tampered_vrf_rejected(self):
+        cfg, vrf_sks, hot_keys = _praos_setup()
+        p = Praos(cfg)
+        headers = _praos_forge_chain(p, vrf_sks, hot_keys, 10)
+        h = headers[0]
+        pi = bytearray(h.get("praos_rho"))
+        pi[5] ^= 0x01
+        bad = h.with_fields(praos_rho=bytes(pi))
+        with pytest.raises(HeaderError):
+            validate_header(p, None, bad, HeaderState.genesis(p),
+                            backend=BACKEND)
+
+    def test_tampered_kes_rejected(self):
+        cfg, vrf_sks, hot_keys = _praos_setup()
+        p = Praos(cfg)
+        headers = _praos_forge_chain(p, vrf_sks, hot_keys, 10)
+        h = headers[0]
+        sig = bytearray(h.get("praos_kes_sig"))
+        sig[3] ^= 0x01
+        bad = h.with_fields(praos_kes_sig=bytes(sig))
+        with pytest.raises(HeaderError):
+            validate_header(p, None, bad, HeaderState.genesis(p),
+                            backend=BACKEND)
+
+    def test_non_leader_rejected(self):
+        """A header whose VRF output is above the issuer's threshold must be
+        rejected even if the proof itself verifies."""
+        cfg, vrf_sks, hot_keys = _praos_setup()
+        low = PraosConfig(nodes=cfg.nodes, k=cfg.k, f=1e-9,
+                          epoch_length=cfg.epoch_length,
+                          kes_depth=cfg.kes_depth,
+                          slots_per_kes_period=cfg.slots_per_kes_period)
+        p_forge = Praos(cfg)          # easy threshold to forge with
+        p_strict = Praos(low)         # near-zero threshold to validate with
+        headers = _praos_forge_chain(p_forge, vrf_sks, hot_keys, 10)
+        with pytest.raises(HeaderError):
+            validate_header(p_strict, None, headers[0],
+                            HeaderState.genesis(p_strict), backend=BACKEND)
+
+    def test_kes_period_evolution(self):
+        """Forging far enough ahead forces KES key evolution; validation
+        still passes because verification recomputes the Merkle root."""
+        cfg, vrf_sks, hot_keys = _praos_setup()
+        p = Praos(cfg)
+        # slots 0..39 span 8 KES periods of length 5 (depth 3 = exactly 8)
+        headers = _praos_forge_chain(p, vrf_sks, hot_keys, 40)
+        assert max(h.slot for h in headers) >= 20
+        res = validate_headers_batched(
+            p, headers, HeaderState.genesis(p), lambda i, h: None,
+            backend=BACKEND)
+        assert res.all_valid
+        assert any(k.period > 0 for k in hot_keys)
