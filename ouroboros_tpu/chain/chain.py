@@ -70,12 +70,9 @@ class ChainProducerState:
         self._bump()
 
     def rollback(self, p: Point) -> bool:
-        rolled = self.chain.rollback(p)
-        if rolled is None:
+        new_chain = self.chain.copy()
+        if not new_chain.truncate_to(p):
             return False
-        new_chain = Chain()
-        new_chain._blocks = list(rolled._blocks)
-        new_chain._index = dict(rolled._index)
         self.chain = new_chain
         for fs in self._followers.values():
             if not self.chain.contains_point(fs.point):

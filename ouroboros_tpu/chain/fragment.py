@@ -33,7 +33,7 @@ class AnchoredFragment(Generic[B]):
         return cls(Point.genesis())
 
     def copy(self) -> "AnchoredFragment[B]":
-        new = AnchoredFragment.__new__(AnchoredFragment)
+        new = type(self).__new__(type(self))
         new.anchor = self.anchor
         new.anchor_block_no = self.anchor_block_no
         new._blocks = list(self._blocks)
@@ -93,20 +93,43 @@ class AnchoredFragment(Generic[B]):
         self._index[b.hash] = len(self._blocks)
         self._blocks.append(b)
 
+    def _rebuild(self, anchor: Point, blocks,
+                 anchor_block_no: int) -> "AnchoredFragment[B]":
+        """Construct a fragment of the same (sub)class without going through
+        the subclass __init__ (subclasses may narrow its signature)."""
+        new = type(self).__new__(type(self))
+        AnchoredFragment.__init__(new, anchor, blocks, anchor_block_no)
+        return new
+
     def rollback(self, p: Point) -> Optional["AnchoredFragment[B]"]:
-        """Fragment truncated so head == p; None if p not on the fragment."""
+        """Fragment truncated so head == p; None if p not on the fragment.
+        Preserves the subclass (Chain.rollback returns a Chain)."""
         if p == self.anchor:
-            return AnchoredFragment(self.anchor, (), self.anchor_block_no)
+            return self._rebuild(self.anchor, (), self.anchor_block_no)
         i = self._index.get(p.hash)
         if i is None or self._blocks[i].slot != p.slot:
             return None
-        return AnchoredFragment(self.anchor, self._blocks[:i + 1],
-                                self.anchor_block_no)
+        return self._rebuild(self.anchor, self._blocks[:i + 1],
+                             self.anchor_block_no)
+
+    def truncate_to(self, p: Point) -> bool:
+        """In-place rollback so head == p; False if p not on the fragment."""
+        if p == self.anchor:
+            self._blocks.clear()
+            self._index.clear()
+            return True
+        i = self._index.get(p.hash)
+        if i is None or self._blocks[i].slot != p.slot:
+            return False
+        for b in self._blocks[i + 1:]:
+            del self._index[b.hash]
+        del self._blocks[i + 1:]
+        return True
 
     def drop_newest(self, n: int) -> "AnchoredFragment[B]":
         keep = len(self._blocks) - n
-        return AnchoredFragment(self.anchor, self._blocks[:max(keep, 0)],
-                                self.anchor_block_no)
+        return self._rebuild(self.anchor, self._blocks[:max(keep, 0)],
+                             self.anchor_block_no)
 
     def anchor_newer_than(self, k: int) -> "AnchoredFragment[B]":
         """Re-anchor so at most k newest blocks remain (the k-suffix)."""
@@ -114,8 +137,8 @@ class AnchoredFragment(Generic[B]):
             return self
         cut = len(self._blocks) - k
         new_anchor_blk = self._blocks[cut - 1]
-        return AnchoredFragment(point_of(new_anchor_blk), self._blocks[cut:],
-                                new_anchor_blk.block_no)
+        return self._rebuild(point_of(new_anchor_blk), self._blocks[cut:],
+                             new_anchor_blk.block_no)
 
     # -- comparisons ---------------------------------------------------------
     def intersect(self, other: "AnchoredFragment[B]") -> Optional[Point]:

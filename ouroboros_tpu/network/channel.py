@@ -32,11 +32,6 @@ class Channel:
     async def recv(self) -> Any:
         return await sim.atomically(self._in.get)
 
-    def try_recv(self):
-        """Non-blocking receive attempt (None if empty); STM-free peek used
-        by the mux demuxer's fairness loop."""
-        raise NotImplementedError("use recv inside the sim")
-
 
 def channel_pair(capacity: int = 64, delay: float = 0.0,
                  label: str = "chan") -> Tuple[Channel, Channel]:

@@ -93,15 +93,23 @@ class MuxChannel:
         self.ingress = TVar(b"", label=f"mux.ingress.{num}.{mode}")
         self.ingress_limit = 0x3FFFF
 
+    EGRESS_CAP = 0xFFFF * 4
+
     async def send(self, data: bytes) -> None:
         """Queue bytes for egress; blocks while previous data undrained
-        (the Wanton backpressure of Egress.hs:77)."""
-        def tx_fn(tx):
-            cur = tx.read(self.egress)
-            if len(cur) + len(data) > 0xFFFF * 4:
-                retry()
-            tx.write(self.egress, cur + data)
-        await sim.atomically(tx_fn)
+        (the Wanton backpressure of Egress.hs:77).  Payloads larger than
+        the egress cap are enqueued in chunks as the muxer drains."""
+        off = 0
+        while off < len(data):
+            def tx_fn(tx, off=off):
+                cur = tx.read(self.egress)
+                room = self.EGRESS_CAP - len(cur)
+                if room <= 0:
+                    retry()
+                chunk = data[off:off + room]
+                tx.write(self.egress, cur + chunk)
+                return len(chunk)
+            off += await sim.atomically(tx_fn)
 
     async def recv(self) -> bytes:
         """Receive whatever bytes have arrived (at least one)."""
@@ -219,10 +227,8 @@ class CodecChannel:
             if self._buf:
                 try:
                     _, used = cbor.loads_prefix(self._buf)
-                except cbor.CBORError as e:
-                    if "truncated" not in str(e):
-                        raise   # corrupt stream, not just a partial message
-                    used = 0
+                except cbor.CBORTruncated:
+                    used = 0   # partial message: wait for more bytes
                 if used:
                     raw, self._buf = self._buf[:used], self._buf[used:]
                     return self._codec.decode(raw)

@@ -182,7 +182,14 @@ async def server_from_producer(session, producer_state, fid: int,
         if ins is None:
             await session.send(MsgAwaitReply())
             while ins is None:
+                # read the version and re-check the instruction with no
+                # yield point in between: a block added during the
+                # MsgAwaitReply send (or any earlier await) is seen here
+                # instead of being lost to the wait below
                 seen = producer_state.version.value
+                ins = producer_state.follower_instruction(fid)
+                if ins is not None:
+                    break
 
                 def wait_change(tx, seen=seen):
                     if tx.read(producer_state.version) == seen:
@@ -228,10 +235,7 @@ async def _apply(msg, fragment, header_store):
         if header_store is not None:
             header_store[msg.header.hash] = msg.header
     elif isinstance(msg, MsgRollBackward):
-        rolled = fragment.rollback(msg.point)
-        if rolled is None:
+        if not fragment.truncate_to(msg.point):
             raise RuntimeError("server rolled back beyond our fragment")
-        fragment._blocks = rolled._blocks
-        fragment._index = rolled._index
     else:
         raise RuntimeError(f"unexpected {msg}")

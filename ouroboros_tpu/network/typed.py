@@ -130,15 +130,24 @@ class PipelinedSession(Session):
         return len(self._outstanding)
 
     async def collect(self):
-        """Await the oldest outstanding reply."""
+        """Await the oldest outstanding reply.
+
+        A reply may span several messages (e.g. ChainSync's MsgAwaitReply
+        followed by the eventual MsgRollForward): when the state after this
+        message still has peer agency, the continuation state goes back to
+        the front of the queue so the next collect() consumes the rest."""
         if not self._outstanding:
             raise ProtocolError(f"{self.spec.name}: nothing to collect")
         reply_in_state = self._outstanding.pop(0)
         msg = await self.channel.recv()
-        if self.spec._next(reply_in_state, msg) is None:
+        nxt = self.spec._next(reply_in_state, msg)
+        if nxt is None:
             raise ProtocolError(
                 f"{self.spec.name}: pipelined peer sent "
                 f"{type(msg).__name__} invalid in state {reply_in_state}")
+        other = SERVER if self.role == CLIENT else CLIENT
+        if self.spec.agency.get(nxt, NOBODY) == other:
+            self._outstanding.insert(0, nxt)
         return msg
 
 

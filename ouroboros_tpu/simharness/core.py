@@ -304,13 +304,19 @@ class Sim:
             # finally/__aexit__ blocks run and GC sees no un-awaited frames.
             # Runs BEFORE restoring _current_sim (cleanup may use sim APIs);
             # cleanup exceptions never replace the simulation's result.
+            interrupt: Optional[BaseException] = None
             for t in self._threads.values():
                 if t.state not in (_DONE, _FAILED):
                     try:
                         t.coro.close()
-                    except BaseException as exc:  # noqa: BLE001
+                    except Exception as exc:
                         self._ev(t, "cleanup-error", repr(exc))
+                    except BaseException as exc:  # KeyboardInterrupt etc.
+                        self._ev(t, "cleanup-error", repr(exc))
+                        interrupt = interrupt or exc
             _current_sim = prev
+            if interrupt is not None:
+                raise interrupt
 
     def _step(self, thread: _Thread):
         # pending STM re-run takes priority (unless an exception is queued)

@@ -11,11 +11,16 @@ from __future__ import annotations
 import struct
 from typing import Any
 
-__all__ = ["dumps", "loads", "CBORError", "Tag"]
+__all__ = ["dumps", "loads", "CBORError", "CBORTruncated", "Tag"]
 
 
 class CBORError(ValueError):
     pass
+
+
+class CBORTruncated(CBORError):
+    """Input ends mid-item — a partial message, not a corrupt stream.
+    Framing layers catch this specifically and wait for more bytes."""
 
 
 class Tag:
@@ -98,7 +103,7 @@ class _Decoder:
 
     def _take(self, n: int) -> bytes:
         if self.pos + n > len(self.data):
-            raise CBORError("truncated CBOR")
+            raise CBORTruncated("truncated CBOR")
         b = self.data[self.pos:self.pos + n]
         self.pos += n
         return b

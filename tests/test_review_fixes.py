@@ -1,0 +1,162 @@
+"""Regression tests for review findings: mux oversize-send chunking,
+ChainSync await-reply lost wakeup, pipelined multi-message replies,
+fragment subclass preservation."""
+import pytest
+
+from ouroboros_tpu import simharness as sim
+from ouroboros_tpu.chain import (
+    AnchoredFragment, Chain, ChainProducerState, Point, make_block,
+)
+from ouroboros_tpu.network.mux import INITIATOR, RESPONDER, Mux, bearer_pair
+from ouroboros_tpu.network import typed
+from ouroboros_tpu.network.channel import channel_pair
+from ouroboros_tpu.network.protocols import chainsync
+from ouroboros_tpu.utils import cbor
+
+
+def test_mux_send_larger_than_egress_cap():
+    """A payload bigger than the egress cap must be chunked, not deadlock."""
+    big = bytes(range(256)) * 1030   # 263,680 bytes > 0xFFFF*4
+
+    async def main():
+        ba, bb = bearer_pair(sdu_size=4096)
+        mux_a, mux_b = Mux(ba, "A"), Mux(bb, "B")
+        cha = mux_a.channel(2, INITIATOR)
+        chb = mux_b.channel(2, RESPONDER)
+        mux_a.start()
+        mux_b.start()
+
+        async def sender():
+            await cha.send(big)
+
+        async def receiver():
+            got = b""
+            while len(got) < len(big):
+                got += await chb.recv()
+            return got
+
+        s = sim.spawn(sender(), label="sender")
+        r = sim.spawn(receiver(), label="receiver")
+        await s.wait()
+        return await r.wait()
+
+    assert sim.run(main()) == big
+
+
+def test_chainsync_block_added_during_await_reply():
+    """A block added while the server sends MsgAwaitReply must not be lost
+    (confirmed lost-wakeup: 44/200 schedules pre-fix)."""
+    b0 = make_block(None, 0)
+    b1 = make_block(b0, 1)
+
+    async def scenario():
+        ps = ChainProducerState()
+        ps.add_block(b0)
+        fid = ps.new_follower()
+
+        ca, cb = channel_pair(label="cs")
+        sess_c = typed.Session(chainsync.SPEC, typed.CLIENT, ca)
+        sess_s = typed.Session(chainsync.SPEC, typed.SERVER, cb)
+
+        srv = sim.spawn(
+            chainsync.server_from_producer(sess_s, ps, fid,
+                                           header_of=lambda b: b),
+            label="server")
+
+        async def client():
+            # drain to tip (first instruction is rollback-to-intersection)
+            await sess_c.send(chainsync.MsgRequestNext())
+            msg = await sess_c.recv()
+            assert isinstance(msg, chainsync.MsgRollBackward)
+            await sess_c.send(chainsync.MsgRequestNext())
+            msg = await sess_c.recv()
+            assert isinstance(msg, chainsync.MsgRollForward)
+            # now at tip: next request makes the server send MsgAwaitReply
+            await sess_c.send(chainsync.MsgRequestNext())
+            msg = await sess_c.recv()
+            assert isinstance(msg, chainsync.MsgAwaitReply)
+            # the eventual reply must be b1 — without waiting for a THIRD
+            # block to bump the version again
+            msg = await sess_c.recv()
+            assert isinstance(msg, chainsync.MsgRollForward)
+            assert msg.header.hash == b1.hash
+            await sess_c.send(chainsync.MsgDone())
+
+        cl = sim.spawn(client(), label="client")
+        # add b1 exactly while the server is inside its MsgAwaitReply send
+        await sim.sleep(0)
+        ps.add_block(b1)
+        ok, _ = await sim.timeout(5.0, cl.wait())
+        assert ok, "client timed out: lost wakeup"
+        await srv.wait()
+
+    # exercise many schedules: the pre-fix bug was schedule-dependent
+    for seed in range(30):
+        sim.run(scenario(), seed=seed)
+
+
+def test_pipelined_multi_message_reply():
+    """MsgAwaitReply + MsgRollForward is ONE pipelined reply in two
+    messages; collect() must keep consuming until client agency returns."""
+    b0 = make_block(None, 0)
+    b1 = make_block(b0, 1)
+
+    async def scenario():
+        ps = ChainProducerState()
+        ps.add_block(b0)
+        fid = ps.new_follower()
+        ca, cb = channel_pair(label="cs")
+        sess_c = typed.PipelinedSession(chainsync.SPEC, typed.CLIENT, ca)
+        sess_s = typed.Session(chainsync.SPEC, typed.SERVER, cb)
+        srv = sim.spawn(
+            chainsync.server_from_producer(sess_s, ps, fid,
+                                           header_of=lambda b: b),
+            label="server")
+
+        async def client():
+            # pipeline two RequestNexts; the second reply starts with
+            # MsgAwaitReply (server at tip) and continues with RollForward
+            for _ in range(3):
+                await sess_c.send_pipelined(chainsync.MsgRequestNext(),
+                                            "StIdle")
+            replies = []
+            while sess_c.outstanding:
+                replies.append(await sess_c.collect())
+            kinds = [type(m).__name__ for m in replies]
+            assert kinds == ["MsgRollBackward", "MsgRollForward",
+                             "MsgAwaitReply", "MsgRollForward"], kinds
+            assert replies[-1].header.hash == b1.hash
+            await sess_c.send(chainsync.MsgDone())
+
+        cl = sim.spawn(client(), label="client")
+        await sim.sleep(1.0)
+        ps.add_block(b1)
+        ok, _ = await sim.timeout(10.0, cl.wait())
+        assert ok
+        await srv.wait()
+
+    sim.run(scenario())
+
+
+def test_fragment_subclass_preserved():
+    b0 = make_block(None, 0)
+    b1 = make_block(b0, 1)
+    ch = Chain([b0, b1])
+    rolled = ch.rollback(Point(b0.slot, b0.hash))
+    assert isinstance(rolled, Chain)
+    assert isinstance(ch.copy(), Chain)
+    frag = AnchoredFragment.from_genesis()
+    frag.add_block(b0)
+    frag.add_block(b1)
+    assert frag.truncate_to(Point(b0.slot, b0.hash))
+    assert frag.head_point == Point(b0.slot, b0.hash)
+    assert not frag.truncate_to(Point(99, b"\x01" * 32))
+
+
+def test_cbor_truncated_type():
+    raw = cbor.dumps([1, 2, b"abc"])
+    with pytest.raises(cbor.CBORTruncated):
+        cbor.loads(raw[:-2])
+    # corrupt (not truncated) input raises plain CBORError
+    with pytest.raises(cbor.CBORError):
+        cbor.loads(raw + b"\x00")
