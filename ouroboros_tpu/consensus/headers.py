@@ -99,6 +99,12 @@ class ProtocolBlock:
         return [self.header.encode(), [t.encode() if hasattr(t, "encode")
                                        else t for t in self.body]]
 
+    @classmethod
+    def decode(cls, obj, tx_decode=None) -> "ProtocolBlock":
+        """tx_decode: per-ledger body-item decoder (default: raw values)."""
+        body = tuple(tx_decode(t) if tx_decode else t for t in obj[1])
+        return cls(ProtocolHeader.decode(obj[0]), body)
+
     @property
     def bytes(self) -> bytes:
         return cbor.dumps(self.encode())

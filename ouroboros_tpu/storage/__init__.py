@@ -1,0 +1,16 @@
+"""Storage layer: injectable FS, ImmutableDB, VolatileDB, LedgerDB, ChainDB.
+
+Reference: ouroboros-consensus/src/Ouroboros/Consensus/Storage/ (SURVEY.md §2
+L5 storage trio + ChainDB).  Every component takes an `FsApi` so tests run
+on the in-memory MockFS with fault injection (the HasFS lesson,
+Storage/FS/API.hs).
+"""
+from .fs import FsApi, IoFS, MockFS, FsError, crc32
+from .immutabledb import ImmutableDB
+from .volatiledb import VolatileDB
+from .ledgerdb import LedgerDB, DiskPolicy
+
+__all__ = [
+    "FsApi", "IoFS", "MockFS", "FsError", "crc32",
+    "ImmutableDB", "VolatileDB", "LedgerDB", "DiskPolicy",
+]

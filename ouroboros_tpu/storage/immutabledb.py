@@ -1,0 +1,215 @@
+"""ImmutableDB — append-only chunked block store with recovery.
+
+Reference: ouroboros-consensus/src/Ouroboros/Consensus/Storage/ImmutableDB/
+(SURVEY.md §2): 3 files per chunk — `.chunk` concatenated blobs,
+`.primary`/`.secondary` indices (Impl/Index/{Primary,Secondary}.hs) with
+per-block CRC; chunk layout maps slots to files (Chunks/Layout.hs); startup
+validation CRCs every block and truncates the corrupt tail
+(Impl/Validation.hs); streaming iterators (Impl/Iterator.hs).
+
+TPU-first simplification that keeps the semantics: one `.secondary` CBOR
+index per chunk (offset/size/crc/hash/slot/block_no per entry); the primary
+(slot→entry) mapping is rebuilt in memory at open — the LRU index cache of
+the reference collapses into the in-memory dict.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+from ..utils import cbor
+from .fs import FsApi, FsError, crc32
+
+DIR = ("immutable",)
+
+
+@dataclass(frozen=True)
+class SecondaryEntry:
+    """One block's index record (Impl/Index/Secondary.hs entry)."""
+    offset: int
+    size: int
+    crc: int
+    hash: bytes
+    prev_hash: bytes
+    slot: int
+    block_no: int
+
+    def encode(self):
+        return [self.offset, self.size, self.crc, self.hash, self.prev_hash,
+                self.slot, self.block_no]
+
+    @classmethod
+    def decode(cls, obj):
+        return cls(int(obj[0]), int(obj[1]), int(obj[2]), bytes(obj[3]),
+                   bytes(obj[4]), int(obj[5]), int(obj[6]))
+
+
+def _chunk_file(n: int) -> tuple:
+    return DIR + (f"{n:05d}.chunk",)
+
+
+def _secondary_file(n: int) -> tuple:
+    return DIR + (f"{n:05d}.secondary",)
+
+
+class ImmutableDB:
+    """Append-only store; blocks enter in strictly increasing slot order
+    (they are ≥k deep, so reorgs never touch them)."""
+
+    def __init__(self, fs: FsApi, chunk_size: int = 100):
+        self.fs = fs
+        self.chunk_size = chunk_size
+        # chunk -> [SecondaryEntry]; slot -> (chunk, idx); hash -> slot
+        self._chunks: dict[int, list[SecondaryEntry]] = {}
+        self._by_slot: dict[int, tuple] = {}
+        self._by_hash: dict[bytes, int] = {}
+        self._slots: list[int] = []          # ascending (append-only)
+        self._tip: Optional[SecondaryEntry] = None
+
+    # -- open + validation ----------------------------------------------------
+    @classmethod
+    def open(cls, fs: FsApi, chunk_size: int = 100,
+             validate_all: bool = True) -> "ImmutableDB":
+        """Open, validating chunks in order; the first corrupt entry
+        truncates the DB there (Impl/Validation.hs tail truncation)."""
+        db = cls(fs, chunk_size)
+        fs.mkdirs(DIR)
+        chunk_nos = sorted(
+            int(name.split(".")[0]) for name in fs.list_dir(DIR)
+            if name.endswith(".chunk"))
+        good = True
+        for n in chunk_nos:
+            if not good:
+                fs.remove(_chunk_file(n))          # past corruption: drop
+                fs.remove(_secondary_file(n))
+                continue
+            good = db._load_chunk(n, validate_all)
+        return db
+
+    def _load_chunk(self, n: int, validate: bool) -> bool:
+        """Load chunk n; returns False if a corrupt tail was truncated."""
+        fs = self.fs
+        try:
+            raw_idx = fs.read_file(_secondary_file(n))
+        except FsError:
+            raw_idx = b""
+        entries: list[SecondaryEntry] = []
+        pos = 0
+        while pos < len(raw_idx):
+            try:
+                obj, used = cbor.loads_prefix(raw_idx[pos:])
+                entries.append(SecondaryEntry.decode(obj))
+                pos += used
+            except (cbor.CBORError, ValueError, IndexError):
+                break
+        try:
+            chunk_len = fs.file_size(_chunk_file(n))
+        except FsError:
+            chunk_len = 0
+        keep: list[SecondaryEntry] = []
+        for e in entries:
+            if e.offset + e.size > chunk_len:
+                break
+            if validate:
+                data = fs.read_range(_chunk_file(n), e.offset, e.size)
+                if crc32(data) != e.crc:
+                    break
+            if self._tip is not None and e.slot <= self._tip.slot:
+                break                               # non-monotone: corrupt
+            keep.append(e)
+            self._index(n, e)
+        end_of_entries = keep[-1].offset + keep[-1].size if keep else 0
+        clean = (len(keep) == len(entries) and pos >= len(raw_idx)
+                 and chunk_len == end_of_entries)   # orphan chunk bytes
+                                                    # (lost index) = corrupt
+        if not clean:
+            end = keep[-1].offset + keep[-1].size if keep else 0
+            if chunk_len > end:
+                fs.truncate_file(_chunk_file(n), end)
+            fs.write_file(_secondary_file(n),
+                          b"".join(cbor.dumps(e.encode()) for e in keep))
+        return clean
+
+    def _index(self, n: int, e: SecondaryEntry) -> None:
+        self._chunks.setdefault(n, []).append(e)
+        self._by_slot[e.slot] = (n, len(self._chunks[n]) - 1)
+        self._by_hash[e.hash] = e.slot
+        self._slots.append(e.slot)
+        self._tip = e
+
+    # -- queries --------------------------------------------------------------
+    @property
+    def tip(self) -> Optional[SecondaryEntry]:
+        return self._tip
+
+    def __contains__(self, h: bytes) -> bool:
+        return h in self._by_hash
+
+    def chunk_of(self, slot: int) -> int:
+        return slot // self.chunk_size
+
+    def get_by_slot(self, slot: int) -> Optional[bytes]:
+        loc = self._by_slot.get(slot)
+        if loc is None:
+            return None
+        n, i = loc
+        e = self._chunks[n][i]
+        return self.fs.read_range(_chunk_file(n), e.offset, e.size)
+
+    def get_by_hash(self, h: bytes) -> Optional[bytes]:
+        slot = self._by_hash.get(h)
+        return None if slot is None else self.get_by_slot(slot)
+
+    def slot_of_hash(self, h: bytes) -> Optional[int]:
+        return self._by_hash.get(h)
+
+    def next_after(self, slot: int) -> Optional[tuple[SecondaryEntry, bytes]]:
+        """(entry, bytes) of the block at the smallest slot > `slot` — lets
+        ChainDB followers stream the immutable chain without iterators."""
+        import bisect
+        i = bisect.bisect_right(self._slots, slot)
+        if i >= len(self._slots):
+            return None
+        s = self._slots[i]
+        n, j = self._by_slot[s]
+        e = self._chunks[n][j]
+        return e, self.fs.read_range(_chunk_file(n), e.offset, e.size)
+
+    def entry_by_hash(self, h: bytes) -> Optional[SecondaryEntry]:
+        slot = self._by_hash.get(h)
+        if slot is None:
+            return None
+        n, i = self._by_slot[slot]
+        return self._chunks[n][i]
+
+    def stream(self, from_slot: int = 0,
+               to_slot: Optional[int] = None
+               ) -> Iterator[tuple[SecondaryEntry, bytes]]:
+        """Iterate (entry, block bytes) in slot order (Impl/Iterator.hs)."""
+        for n in sorted(self._chunks):
+            for e in self._chunks[n]:
+                if e.slot < from_slot:
+                    continue
+                if to_slot is not None and e.slot > to_slot:
+                    return
+                yield e, self.fs.read_range(_chunk_file(n), e.offset, e.size)
+
+    def __len__(self) -> int:
+        return len(self._by_slot)
+
+    # -- append ---------------------------------------------------------------
+    def append_block(self, slot: int, block_no: int, h: bytes,
+                     prev_hash: bytes, data: bytes) -> None:
+        if self._tip is not None and slot <= self._tip.slot:
+            raise ValueError(
+                f"append slot {slot} not after tip slot {self._tip.slot}")
+        n = self.chunk_of(slot)
+        try:
+            offset = self.fs.file_size(_chunk_file(n))
+        except FsError:
+            offset = 0
+        e = SecondaryEntry(offset, len(data), crc32(data), h, prev_hash,
+                           slot, block_no)
+        self.fs.append_file(_chunk_file(n), data)
+        self.fs.append_file(_secondary_file(n), cbor.dumps(e.encode()))
+        self._index(n, e)

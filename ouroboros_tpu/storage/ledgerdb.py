@@ -1,0 +1,148 @@
+"""LedgerDB — in-memory k-bounded ledger snapshots + on-disk checkpoints.
+
+Reference: ouroboros-consensus/src/Ouroboros/Consensus/Storage/LedgerDB/
+InMemory.hs:250-449 (anchored sequence of ledger states per block up to k,
+`ledgerDbPush`/`ledgerDbSwitch`), OnDisk.hs:27-421 (CBOR snapshots
+`takeSnapshot`/`readSnapshot`/`trimSnapshots` named by slot, replay from
+newest snapshot at open), DiskPolicy.hs.
+
+The in-memory sequence keeps a state per block so any rollback ≤ k is a
+list truncation, not a replay.  The batched validation path
+(consensus/batch.py validate_blocks_batched) plugs in via `switch`'s
+`apply` callback returning the window's states at once.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence
+
+from ..chain.block import Point
+from ..utils import cbor
+from .fs import FsApi, FsError
+
+DIR = ("ledger",)
+
+
+@dataclass(frozen=True)
+class DiskPolicy:
+    """How many snapshots to keep, and how often to take them
+    (DiskPolicy.hs)."""
+    num_snapshots: int = 2
+    snapshot_interval_slots: int = 100
+
+
+class LedgerDB:
+    """Anchored sequence: anchor state (at the immutable tip) + one state
+    per volatile block (≤ k of them, newest last)."""
+
+    def __init__(self, k: int, anchor_point: Point, anchor_state: Any):
+        self.k = k
+        self.anchor_point = anchor_point
+        self.anchor_state = anchor_state
+        self._states: list[tuple[Point, Any]] = []
+
+    # -- queries --------------------------------------------------------------
+    @property
+    def current(self) -> Any:
+        return self._states[-1][1] if self._states else self.anchor_state
+
+    @property
+    def tip_point(self) -> Point:
+        return self._states[-1][0] if self._states else self.anchor_point
+
+    def __len__(self) -> int:
+        return len(self._states)
+
+    def state_at(self, point: Point) -> Optional[Any]:
+        """State whose tip is `point` (LocalStateQuery acquire semantics)."""
+        if point == self.anchor_point:
+            return self.anchor_state
+        for p, s in self._states:
+            if p == point:
+                return s
+        return None
+
+    def past_points(self) -> list[Point]:
+        return [self.anchor_point] + [p for p, _ in self._states]
+
+    # -- updates --------------------------------------------------------------
+    def push(self, point: Point, state: Any) -> None:
+        """ledgerDbPush + implicit prune to k."""
+        self._states.append((point, state))
+        if len(self._states) > self.k:
+            # the oldest state becomes the new anchor (copy-to-immutable)
+            self.anchor_point, self.anchor_state = self._states[0]
+            del self._states[0]
+
+    def prune_to_slot(self, slot: int) -> None:
+        """Advance the anchor until it is at or past `slot` (called when the
+        immutable tip advances — the copy-to-immutable path)."""
+        while self.anchor_point.slot < slot and self._states:
+            self.anchor_point, self.anchor_state = self._states[0]
+            del self._states[0]
+
+    def rollback(self, n: int) -> bool:
+        """Drop the newest n states; False if n > len (deeper than k)."""
+        if n > len(self._states):
+            return False
+        if n:
+            del self._states[-n:]
+        return True
+
+    def switch(self, rollback_n: int,
+               apply_window: Callable[[Any], Sequence[tuple[Point, Any]]]
+               ) -> bool:
+        """ledgerDbSwitch: rollback n then apply a window of new blocks.
+
+        apply_window(state_at_fork) returns the new (point, state) pairs —
+        typically produced by ONE batched validate_blocks_batched call.
+        """
+        if rollback_n > len(self._states):
+            return False
+        saved = self._states[len(self._states) - rollback_n:]
+        if rollback_n:
+            del self._states[-rollback_n:]
+        try:
+            new = apply_window(self.current)
+        except Exception:
+            self._states.extend(saved)
+            raise
+        for p, s in new:
+            self.push(p, s)
+        return True
+
+    # -- on-disk snapshots ----------------------------------------------------
+    @staticmethod
+    def _snap_file(slot: int) -> tuple:
+        return DIR + (f"snap-{slot:012d}",)
+
+    @staticmethod
+    def take_snapshot(fs: FsApi, slot: int, point: Point, state: Any,
+                      encode_state: Callable[[Any], Any],
+                      policy: DiskPolicy = DiskPolicy()) -> None:
+        """Write a snapshot named by slot; trim old ones (OnDisk.hs:343,
+        trimSnapshots)."""
+        fs.mkdirs(DIR)
+        payload = cbor.dumps([point.encode(), encode_state(state)])
+        fs.write_file(LedgerDB._snap_file(slot), payload)
+        snaps = sorted(n for n in fs.list_dir(DIR) if n.startswith("snap-"))
+        for name in snaps[:-policy.num_snapshots]:
+            fs.remove(DIR + (name,))
+
+    @staticmethod
+    def read_latest_snapshot(fs: FsApi,
+                             decode_state: Callable[[Any], Any]
+                             ) -> Optional[tuple[int, Point, Any]]:
+        """Newest readable snapshot: (slot, point, state); corrupt snapshots
+        are skipped, falling back to older ones (OnDisk.hs resume)."""
+        snaps = sorted((n for n in fs.list_dir(DIR) if n.startswith("snap-")),
+                       reverse=True)
+        for name in snaps:
+            try:
+                obj = cbor.loads(fs.read_file(DIR + (name,)))
+                point = Point.decode(obj[0])
+                state = decode_state(obj[1])
+                return int(name.split("-")[1]), point, state
+            except (cbor.CBORError, FsError, ValueError, IndexError):
+                continue
+        return None

@@ -1,0 +1,303 @@
+"""Storage layer tests: model-based random ops + corruption recovery.
+
+Mirrors the reference's storage q-s-m suites (SURVEY.md §4.2: ImmutableDB/
+VolatileDB state machines with corruption commands; LedgerDB OnDisk).
+"""
+import random
+
+import pytest
+
+from ouroboros_tpu.chain.block import Point
+from ouroboros_tpu.storage import (
+    DiskPolicy, FsError, ImmutableDB, IoFS, LedgerDB, MockFS, VolatileDB,
+)
+
+
+def _blk(i: int, prev: bytes) -> tuple:
+    h = bytes([i % 256, (i >> 8) % 256]) + bytes(30)
+    data = b"block-%06d-" % i + b"x" * (i % 97)
+    return h, prev, data
+
+
+class TestImmutableDB:
+    def test_append_read_stream(self):
+        fs = MockFS()
+        db = ImmutableDB.open(fs, chunk_size=10)
+        prev = b"\x00" * 32
+        for i in range(35):
+            h, p, data = _blk(i, prev)
+            db.append_block(slot=i * 2, block_no=i, h=h, prev_hash=p,
+                            data=data)
+            prev = h
+        assert db.tip.slot == 68 and db.tip.block_no == 34
+        assert db.get_by_slot(20) == b"block-%06d-" % 10 + b"x" * (10 % 97)
+        assert db.get_by_slot(21) is None
+        got = [e.slot for e, _ in db.stream(10, 30)]
+        assert got == list(range(10, 31, 2))
+
+    def test_reopen_preserves(self):
+        fs = MockFS()
+        db = ImmutableDB.open(fs, chunk_size=5)
+        prev = b"\x00" * 32
+        for i in range(12):
+            h, p, data = _blk(i, prev)
+            db.append_block(i, i, h, p, data)
+            prev = h
+        db2 = ImmutableDB.open(fs, chunk_size=5)
+        assert db2.tip.slot == 11
+        assert [e.slot for e, _ in db2.stream()] == list(range(12))
+        assert db2.get_by_hash(db.tip.hash) is not None
+
+    def test_corrupt_tail_truncated(self):
+        fs = MockFS()
+        db = ImmutableDB.open(fs, chunk_size=100)
+        prev = b"\x00" * 32
+        for i in range(10):
+            h, p, data = _blk(i, prev)
+            db.append_block(i, i, h, p, data)
+            prev = h
+        # flip a byte inside block 7's stored bytes
+        path = ("immutable", "00000.chunk")
+        entry7 = db._chunks[0][7]
+        fs.files[path][entry7.offset + 3] ^= 0xFF
+        db2 = ImmutableDB.open(fs, chunk_size=100)
+        assert db2.tip.slot == 6                      # 7,8,9 truncated
+        assert len(db2) == 7
+        # can append again after truncation
+        h, p, data = _blk(99, db2.tip.hash)
+        db2.append_block(99, 7, h, p, data)
+        assert db2.tip.slot == 99
+
+    def test_corrupt_index_truncated(self):
+        fs = MockFS()
+        db = ImmutableDB.open(fs, chunk_size=100)
+        prev = b"\x00" * 32
+        for i in range(6):
+            h, p, data = _blk(i, prev)
+            db.append_block(i, i, h, p, data)
+            prev = h
+        idx = ("immutable", "00000.secondary")
+        fs.files[idx] = fs.files[idx][:len(fs.files[idx]) - 7]  # torn write
+        db2 = ImmutableDB.open(fs, chunk_size=100)
+        assert db2.tip.slot == 4
+        assert len(db2) == 5
+
+    def test_later_chunks_dropped_after_corruption(self):
+        fs = MockFS()
+        db = ImmutableDB.open(fs, chunk_size=3)
+        prev = b"\x00" * 32
+        for i in range(9):                            # chunks 0,1,2
+            h, p, data = _blk(i, prev)
+            db.append_block(i, i, h, p, data)
+            prev = h
+        e = db._chunks[1][0]
+        fs.files[("immutable", "00001.chunk")][e.offset] ^= 0x55
+        db2 = ImmutableDB.open(fs, chunk_size=3)
+        assert db2.tip.slot == 2                      # chunk 1 cut, chunk 2 dropped
+        assert not fs.exists(("immutable", "00002.chunk"))
+
+    def test_non_monotone_append_rejected(self):
+        fs = MockFS()
+        db = ImmutableDB.open(fs)
+        h, p, data = _blk(0, b"\x00" * 32)
+        db.append_block(5, 0, h, p, data)
+        with pytest.raises(ValueError):
+            db.append_block(5, 1, b"\x01" * 32, h, b"dup")
+
+    def test_real_fs(self, tmp_path):
+        fs = IoFS(str(tmp_path))
+        db = ImmutableDB.open(fs, chunk_size=4)
+        prev = b"\x00" * 32
+        for i in range(9):
+            h, p, data = _blk(i, prev)
+            db.append_block(i, i, h, p, data)
+            prev = h
+        db2 = ImmutableDB.open(fs, chunk_size=4)
+        assert db2.tip.slot == 8 and len(db2) == 9
+
+
+class TestVolatileDB:
+    def test_put_get_successors(self):
+        fs = MockFS()
+        db = VolatileDB.open(fs, max_blocks_per_file=3)
+        g = b"\x00" * 32
+        h1, _, d1 = _blk(1, g)
+        h2, _, d2 = _blk(2, h1)
+        h3, _, d3 = _blk(3, h1)          # fork off h1
+        db.put_block(h1, g, 1, 0, d1)
+        db.put_block(h2, h1, 2, 1, d2)
+        db.put_block(h3, h1, 3, 1, d3)
+        assert db.get_block(h2) == d2
+        assert db.filter_by_predecessor(h1) == {h2, h3}
+        assert db.filter_by_predecessor(h2) == frozenset()
+        db.put_block(h1, g, 1, 0, d1)     # idempotent
+        assert len(db) == 3
+
+    def test_reopen_reindexes(self):
+        fs = MockFS()
+        db = VolatileDB.open(fs, max_blocks_per_file=2)
+        g = b"\x00" * 32
+        hashes = []
+        prev = g
+        for i in range(7):
+            h, p, d = _blk(i, prev)
+            db.put_block(h, p, i, i, d)
+            hashes.append((h, d))
+            prev = h
+        db2 = VolatileDB.open(fs, max_blocks_per_file=2)
+        assert len(db2) == 7
+        for h, d in hashes:
+            assert db2.get_block(h) == d
+        # can still add after reopen
+        h, p, d = _blk(100, prev)
+        db2.put_block(h, p, 100, 7, d)
+        assert db2.get_block(h) == d
+
+    def test_torn_tail_recovered(self):
+        fs = MockFS()
+        db = VolatileDB.open(fs, max_blocks_per_file=100)
+        g = b"\x00" * 32
+        h1, _, d1 = _blk(1, g)
+        h2, _, d2 = _blk(2, h1)
+        db.put_block(h1, g, 1, 0, d1)
+        db.put_block(h2, h1, 2, 1, d2)
+        path = ("volatile", "vol-00000.dat")
+        fs.files[path] = fs.files[path][:-5]          # torn write on h2
+        db2 = VolatileDB.open(fs, max_blocks_per_file=100)
+        assert h1 in db2 and h2 not in db2
+        # re-put works
+        db2.put_block(h2, h1, 2, 1, d2)
+        assert db2.get_block(h2) == d2
+
+    def test_gc_by_slot(self):
+        fs = MockFS()
+        db = VolatileDB.open(fs, max_blocks_per_file=2)
+        g = b"\x00" * 32
+        prev = g
+        hs = []
+        for i in range(6):
+            h, p, d = _blk(i, prev)
+            db.put_block(h, p, i, i, d)
+            hs.append(h)
+            prev = h
+        db.garbage_collect(4)      # files [0,1],[2,3] go; [4,5] stays
+        assert hs[0] not in db and hs[3] not in db
+        assert hs[4] in db and hs[5] in db
+        assert not fs.exists(("volatile", "vol-00000.dat"))
+
+    def test_model_random_ops(self):
+        rng = random.Random(42)
+        fs = MockFS()
+        db = VolatileDB.open(fs, max_blocks_per_file=3)
+        model: dict[bytes, bytes] = {}
+        g = b"\x00" * 32
+        all_blocks = []
+        prev = g
+        for i in range(60):
+            h, p, d = _blk(i, prev)
+            all_blocks.append((h, p, i, i, d))
+            prev = h
+        for step in range(200):
+            op = rng.random()
+            if op < 0.5 and all_blocks:
+                h, p, s, bn, d = all_blocks[rng.randrange(len(all_blocks))]
+                db.put_block(h, p, s, bn, d)
+                model[h] = d
+            elif op < 0.8 and model:
+                h = rng.choice(list(model))
+                assert db.get_block(h) == model[h]
+            elif op < 0.9:
+                # reopen round-trip
+                db = VolatileDB.open(fs, max_blocks_per_file=3)
+                assert len(db) == len(model)
+            else:
+                cut = rng.randrange(60)
+                db.garbage_collect(cut)
+                # model: file-granular GC only removes what db removed
+                model = {h: d for h, d in model.items() if h in db}
+        for h, d in model.items():
+            assert db.get_block(h) == d
+
+
+class TestLedgerDB:
+    def _pt(self, i):
+        return Point(i, bytes([i]) + bytes(31))
+
+    def test_push_prune_rollback(self):
+        db = LedgerDB(k=3, anchor_point=Point.genesis(), anchor_state=0)
+        for i in range(5):
+            db.push(self._pt(i), i * 10)
+        assert db.current == 40
+        assert len(db) == 3                      # pruned to k
+        assert db.anchor_state == 10             # state 1 became anchor
+        assert db.rollback(2)
+        assert db.current == 20
+        assert not db.rollback(5)                # deeper than k
+
+    def test_switch_applies_window_atomically(self):
+        db = LedgerDB(k=10, anchor_point=Point.genesis(), anchor_state=0)
+        for i in range(4):
+            db.push(self._pt(i), i + 1)
+        ok = db.switch(2, lambda st: [(self._pt(10), st + 100),
+                                      (self._pt(11), st + 200)])
+        assert ok and db.current == 202 and db.tip_point == self._pt(11)
+        # failed window restores the rolled-back states
+        def boom(st):
+            raise RuntimeError("validation failed")
+        with pytest.raises(RuntimeError):
+            db.switch(1, boom)
+        assert db.current == 202
+
+    def test_state_at_and_past_points(self):
+        db = LedgerDB(k=5, anchor_point=Point.genesis(), anchor_state="a")
+        db.push(self._pt(0), "s0")
+        db.push(self._pt(1), "s1")
+        assert db.state_at(self._pt(0)) == "s0"
+        assert db.state_at(Point.genesis()) == "a"
+        assert db.state_at(self._pt(9)) is None
+        assert db.past_points() == [Point.genesis(), self._pt(0),
+                                    self._pt(1)]
+
+    def test_snapshots_roundtrip_and_trim(self):
+        fs = MockFS()
+        enc = lambda s: s
+        dec = lambda o: o
+        for slot in (10, 20, 30):
+            LedgerDB.take_snapshot(fs, slot, self._pt(slot % 256),
+                                   [slot, b"state"], enc,
+                                   DiskPolicy(num_snapshots=2))
+        names = fs.list_dir(("ledger",))
+        assert len(names) == 2                   # trimmed to 2
+        got = LedgerDB.read_latest_snapshot(fs, dec)
+        assert got is not None
+        slot, point, state = got
+        assert slot == 30 and state[0] == 30
+
+    def test_corrupt_snapshot_falls_back(self):
+        fs = MockFS()
+        enc = lambda s: s
+        dec = lambda o: o
+        LedgerDB.take_snapshot(fs, 10, self._pt(10), [10], enc)
+        LedgerDB.take_snapshot(fs, 20, self._pt(20), [20], enc)
+        fs.files[("ledger", "snap-000000000020")][2] ^= 0xFF
+        got = LedgerDB.read_latest_snapshot(fs, dec)
+        assert got is not None and got[0] == 10
+
+
+class TestImmutableLostIndex:
+    def test_missing_secondary_index_truncates_chunk(self):
+        """A chunk with data but no index is corrupt: its bytes and all
+        later chunks must be dropped, not silently skipped."""
+        fs = MockFS()
+        db = ImmutableDB.open(fs, chunk_size=3)
+        prev = b"\x00" * 32
+        for i in range(6):                     # chunks 0 and 1
+            h, p, data = _blk(i, prev)
+            db.append_block(i, i, h, p, data)
+            prev = h
+        del fs.files[("immutable", "00000.secondary")]
+        db2 = ImmutableDB.open(fs, chunk_size=3)
+        assert db2.tip is None and len(db2) == 0
+        assert not fs.exists(("immutable", "00001.chunk"))
+        # chunk 0's orphaned bytes were truncated away
+        assert fs.file_size(("immutable", "00000.chunk")) == 0
