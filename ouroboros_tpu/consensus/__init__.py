@@ -16,8 +16,10 @@ from .ledger import (
     OutsideForecastRange,
 )
 from .batch import validate_headers_batched, BatchValidationResult
+from .mempool import Mempool, MempoolReader, MempoolSnapshot
 
 __all__ = [
+    "Mempool", "MempoolReader", "MempoolSnapshot",
     "ConsensusProtocol", "NullProtocol",
     "HeaderError", "HeaderState", "HeaderStateHistory", "validate_header",
     "revalidate_header",

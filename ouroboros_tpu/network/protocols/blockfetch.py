@@ -106,6 +106,18 @@ CODEC = Codec([MsgRequestRange, MsgClientDone, MsgStartBatch, MsgNoBlocks,
                MsgBlock, MsgBatchDone])
 
 
+def make_codec(block_decode) -> Codec:
+    """Codec with a custom block decoder (codecBlockFetch parameterised
+    over the block type — Protocol/BlockFetch/Codec.hs)."""
+    class _Block(MsgBlock):
+        @classmethod
+        def decode_args(cls, a):
+            return cls(block_decode(a[0]))
+    _Block.__name__ = "MsgBlock"
+    return Codec([MsgRequestRange, MsgClientDone, MsgStartBatch,
+                  MsgNoBlocks, _Block, MsgBatchDone])
+
+
 async def server_from_blocks(session, lookup_range):
     """Server: lookup_range(start, end) -> list[Block] | None.
 

@@ -142,6 +142,20 @@ CODEC = Codec([MsgRequestNext, MsgAwaitReply, MsgRollForward,
                MsgIntersectNotFound, MsgDone])
 
 
+def make_codec(header_decode) -> Codec:
+    """Codec with a custom header decoder (per-block-type codecs, the
+    reference's `codecChainSync` parameterised over the header —
+    Protocol/ChainSync/Codec.hs).  header_decode: CBOR object -> header."""
+    class _RollForward(MsgRollForward):
+        @classmethod
+        def decode_args(cls, a):
+            return cls(header_decode(a[0]), Tip.decode(a[1]))
+    _RollForward.__name__ = "MsgRollForward"
+    return Codec([MsgRequestNext, MsgAwaitReply, _RollForward,
+                  MsgRollBackward, MsgFindIntersect, MsgIntersectFound,
+                  MsgIntersectNotFound, MsgDone])
+
+
 async def server_from_producer(session, producer_state, fid: int,
                                header_of=None):
     """ChainSync server driven by a ChainProducerState follower

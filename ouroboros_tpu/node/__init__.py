@@ -1,0 +1,17 @@
+"""Node layer — the NodeKernel and its hot loops.
+
+Rebuilds /root/reference/ouroboros-consensus's node tier (SURVEY.md §2 L5:
+NodeKernel.hs, MiniProtocol/ChainSync/Client.hs, BlockFetch logic) the TPU
+way: the ChainSync client validates headers in *batched windows* (one device
+call per window instead of per header), and block forging/fetching run as
+simharness threads coordinated through STM TVars exactly like the
+reference's IOLike threads.
+"""
+from .blockchain_time import BlockchainTime
+from .kernel import BlockForging, NodeKernel, connect_nodes
+from .chain_sync import CandidateState, ChainSyncClientError
+
+__all__ = [
+    "BlockchainTime", "BlockForging", "NodeKernel", "connect_nodes",
+    "CandidateState", "ChainSyncClientError",
+]

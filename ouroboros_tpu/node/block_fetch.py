@@ -1,0 +1,216 @@
+"""BlockFetch logic — the download governor.
+
+Reference: ouroboros-network/src/Ouroboros/Network/BlockFetch/Decision.hs:
+150-184,526 (pure decision pipeline: filter plausible candidates → filter
+already-fetched/in-flight → prioritise → per-peer requests with in-flight
+limits), BlockFetch.hs:239 (logic iteration loop re-run on STM change),
+ClientState.hs (per-peer in-flight tracking), BlockFetch/Client.hs (protocol
+adapter), BlockFetch/Server.hs (server from a ChainDB iterator).
+
+The decision pipeline is a pure function over immutable snapshots
+(fetch_decisions) so it is testable exactly like the reference's
+property-tested `fetchDecisions`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Sequence
+
+from .. import simharness as sim
+from ..chain.block import Point, point_of
+from ..network.protocols.blockfetch import fetch_range
+from ..simharness import Retry, TQueue, TVar
+
+
+@dataclass(frozen=True)
+class FetchRequest:
+    """A contiguous run of headers to download from one peer.
+
+    start is EXCLUSIVE (the predecessor point), matching the server's
+    (from, to] streaming semantics; headers are oldest..newest."""
+    peer_id: object
+    start: Point
+    headers: tuple
+
+    @property
+    def end(self) -> Point:
+        return point_of(self.headers[-1])
+
+
+class PeerFetchState:
+    """Per-peer fetch bookkeeping (ClientState.hs `PeerFetchStatus` +
+    request queue)."""
+
+    def __init__(self, peer_id):
+        self.peer_id = peer_id
+        self.queue = TQueue(label=f"fetch-req-{peer_id}")
+        self.in_flight: set[bytes] = set()     # header hashes requested
+        # scan frontier: everything on the candidate up to this point is
+        # known-stored, so decision rounds skip it (keeps a long sync from
+        # rescanning the fragment from its anchor every round)
+        self.done_through: Optional[Point] = None
+
+    @property
+    def busy(self) -> bool:
+        return bool(self.in_flight)
+
+
+def fetch_decisions(
+        candidates: Dict[object, object],
+        peer_states: Dict[object, PeerFetchState],
+        plausible: Callable[[object], bool],
+        have_block: Callable[[bytes], bool],
+        max_blocks_per_request: int = 16) -> list[FetchRequest]:
+    """The pure decision pipeline (Decision.hs:150-184).
+
+    candidates: peer -> AnchoredFragment of validated headers (or None).
+    plausible:  fragment -> would we prefer this chain over ours?
+    have_block: hash -> already stored in the ChainDB?
+
+    Per peer, at most one outstanding request (the reference allows a
+    configurable in-flight budget; one range per peer keeps requests maximal
+    and peers busy).  Blocks in flight with ANY peer are not re-requested
+    (filter already-in-flight), so concurrent peers fetch disjoint runs.
+    """
+    claimed: set[bytes] = set()
+    for ps in peer_states.values():
+        claimed |= ps.in_flight
+        for req in _queued(ps.queue):
+            claimed |= {h.hash for h in req.headers}
+
+    decisions: list[FetchRequest] = []
+    # deterministic peer order: better candidates first, then peer id
+    def head_key(item):
+        peer, frag = item
+        bn = frag.head_block_no if frag is not None and len(frag) else -1
+        return (-bn, str(peer))
+
+    for peer, frag in sorted(candidates.items(), key=head_key):
+        if frag is None or len(frag) == 0 or not plausible(frag):
+            continue
+        ps = peer_states.get(peer)
+        if ps is None or ps.busy or _queued(ps.queue):
+            continue
+        # resume the scan at the stored frontier when it is still on the
+        # fragment (a rollback may have invalidated it — then rescan)
+        blocks = None
+        prev_point = frag.anchor
+        if ps.done_through is not None:
+            blocks = frag.after_point(ps.done_through)
+            if blocks is not None:
+                prev_point = ps.done_through
+            else:
+                ps.done_through = None
+        if blocks is None:
+            blocks = frag.blocks
+        run: list = []
+        start: Optional[Point] = None
+        frontier_ok = True               # still in the contiguous stored prefix
+        for h in blocks:
+            stored = have_block(h.hash)
+            needed = not stored and h.hash not in claimed
+            if needed:
+                if not run:
+                    start = prev_point
+                run.append(h)
+                if len(run) >= max_blocks_per_request:
+                    break
+            elif run:
+                break                    # only the first contiguous run
+            elif stored and frontier_ok:
+                # advance the frontier cache over the stored prefix only —
+                # never past an unstored (claimed) block whose fetch may
+                # still fail
+                ps.done_through = point_of(h)
+            # a claimed-by-another-peer block is skipped: a later run may
+            # still be assignable to this peer (disjoint parallel fetch)
+            if not stored:
+                frontier_ok = False
+            prev_point = point_of(h)
+        if run:
+            req = FetchRequest(peer, start, tuple(run))
+            claimed |= {h.hash for h in run}
+            decisions.append(req)
+    return decisions
+
+
+def _queued(q: TQueue) -> list:
+    """Non-transactional peek at queued requests (cooperative runtime —
+    safe between awaits)."""
+    out = []
+    cons = q._back.value
+    while cons is not None:
+        item, cons = cons
+        out.append(item)
+    out.reverse()
+    front = []
+    cons = q._front.value
+    while cons is not None:
+        item, cons = cons
+        front.append(item)
+    return front + out
+
+
+async def fetch_logic_loop(kernel) -> None:
+    """The blockFetchLogic iteration thread (BlockFetch.hs:239): re-runs
+    the decision pipeline whenever a candidate, the current chain, or the
+    in-flight set changes, and enqueues requests to per-peer clients."""
+    while True:
+        seen = kernel.fetch_wakeup.value
+        decisions = fetch_decisions(
+            {p: c.fragment for p, c in kernel.candidates.items()},
+            kernel.peer_fetch,
+            kernel.plausible_candidate,
+            kernel.have_block)
+        for req in decisions:
+            ps = kernel.peer_fetch[req.peer_id]
+            ps.in_flight |= {h.hash for h in req.headers}
+
+            def push(tx, ps=ps, req=req):
+                ps.queue.put(tx, req)
+            await sim.atomically(push)
+        # wait for something to change
+        def wait_change(tx, seen=seen):
+            if tx.read(kernel.fetch_wakeup) == seen:
+                raise Retry()
+        await sim.atomically(wait_change)
+
+
+async def block_fetch_client(session, kernel, peer_id) -> None:
+    """Per-peer fetch worker: executes assigned FetchRequests over the
+    BlockFetch mini-protocol and feeds blocks into the ChainDB
+    (BlockFetch/Client.hs + addFetchedBlock).
+
+    On any failure the peer's in-flight claims are released and the peer is
+    dropped from fetch consideration — otherwise its claimed hashes would
+    block every other peer from ever re-requesting that chain segment."""
+    ps = kernel.peer_fetch[peer_id]
+    try:
+        while True:
+            req = await sim.atomically(lambda tx: ps.queue.get(tx))
+            try:
+                blocks = await fetch_range(session, req.start, req.end)
+                for b in blocks or ():
+                    kernel.add_fetched_block(b)
+            finally:
+                ps.in_flight -= {h.hash for h in req.headers}
+            ps.done_through = req.end
+            kernel.poke_fetch_logic()
+    except sim.AsyncCancelled:
+        raise
+    except Exception as e:
+        sim.trace_event(("block-fetch-kill", kernel.label, peer_id,
+                         repr(e)))
+        ps.in_flight.clear()
+        kernel.drop_peer(peer_id)
+        raise
+
+
+def block_fetch_server(chain_db):
+    """Server peer function streaming ranges from the ChainDB."""
+    from ..network.protocols.blockfetch import server_from_blocks
+
+    async def server(session):
+        await server_from_blocks(
+            session, lambda start, end: chain_db.stream_blocks(start, end))
+    return server

@@ -1,0 +1,59 @@
+"""BlockchainTime — wall-clock slot ticking.
+
+Reference: ouroboros-consensus/src/Ouroboros/Consensus/BlockchainTime/
+{API.hs,WallClock/Default.hs,Simple.hs}: a `BlockchainTime` exposes the
+current slot as an STM view, advanced by a background thread watching the
+(virtual) clock.  Fixed slot length only — the HFC-aware version layers era
+translation on top (WallClock/HardFork.hs).
+"""
+from __future__ import annotations
+
+from .. import simharness as sim
+from ..simharness import Retry, TVar
+
+
+class BlockchainTime:
+    """Current-slot TVar driven by the simharness virtual clock.
+
+    Slot s spans [s*slot_length, (s+1)*slot_length).  `start()` spawns the
+    ticker thread; `wait_slot_after(prev)` blocks (STM retry) until the
+    current slot exceeds `prev` — the knownSlotWatcher pattern the forging
+    loop uses (NodeKernel.hs:344-351).
+    """
+
+    def __init__(self, slot_length: float = 1.0):
+        self.slot_length = slot_length
+        self.current: TVar = TVar(self._slot_of_now(), label="current-slot")
+        self._ticker = None
+
+    def _slot_of_now(self) -> int:
+        try:
+            return int(sim.now() / self.slot_length)
+        except Exception:
+            return 0                     # outside the sim: epoch start
+
+    def start(self, label: str = "btime") -> None:
+        self._ticker = sim.spawn(self._tick_loop(), label=label)
+
+    def stop(self) -> None:
+        if self._ticker is not None:
+            self._ticker.cancel()
+            self._ticker = None
+
+    async def _tick_loop(self) -> None:
+        while True:
+            nxt = self.current.value + 1
+            at = nxt * self.slot_length
+            delay = at - sim.now()
+            if delay > 0:
+                await sim.sleep(delay)
+            self.current.set_notify(int(sim.now() / self.slot_length))
+
+    async def wait_slot_after(self, prev: int) -> int:
+        """Block until the current slot is > prev; return it."""
+        def tx_fn(tx):
+            s = tx.read(self.current)
+            if s <= prev:
+                raise Retry()
+            return s
+        return await sim.atomically(tx_fn)

@@ -1,0 +1,241 @@
+"""Consensus-side ChainSync client + server.
+
+Reference: ouroboros-consensus/src/Ouroboros/Consensus/MiniProtocol/
+ChainSync/Client.hs:418-431 (intersect, then pipelined roll-forward with
+full header validation per header at :792, candidate-fragment STM publish,
+kill on invalid header / too-deep rollback at :1114) and ChainSync/Server.hs
+(server from a ChainDB follower).
+
+TPU-first redesign of the client hot loop: instead of validating each
+header as it arrives (the reference's per-header `validateHeader`), the
+client pipelines up to `window` MsgRequestNext, buffers the roll-forwards,
+and validates the whole buffer through consensus/batch.py — ONE device
+batch for all VRF/KES/Ed25519 proofs in the window.  While syncing this
+turns thousands of device round-trips into dozens; when caught up the
+window degrades gracefully to batch-of-1.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import simharness as sim
+from ..chain.block import Point, point_of
+from ..chain.fragment import AnchoredFragment
+from ..consensus.batch import validate_headers_batched
+from ..consensus.header_validation import HeaderState, HeaderStateHistory
+from ..network.protocols.chainsync import (
+    MsgAwaitReply, MsgFindIntersect, MsgIntersectFound, MsgIntersectNotFound,
+    MsgRequestNext, MsgRollBackward, MsgRollForward,
+)
+from ..simharness import Retry, TVar
+
+# Fibonacci-ish offsets for intersection points, like the reference's
+# chainSyncClient headerPoints (Client.hs mkPoints)
+_OFFSETS = (0, 1, 2, 3, 5, 8, 13, 21, 34, 55, 89, 144)
+
+
+class ChainSyncClientError(Exception):
+    """Peer sent an invalid header / rolled back too deep — disconnect and
+    (for invalid headers) remember the block as bad (Client.hs:1114)."""
+
+
+class CandidateState:
+    """Per-peer candidate header chain published to BlockFetch
+    (the candidate-fragment map entry, NodeKernel.hs:156)."""
+
+    def __init__(self, peer_id):
+        self.peer_id = peer_id
+        self.fragment: Optional[AnchoredFragment] = None
+        self.version = TVar(0, label=f"candidate-{peer_id}")
+        self._v = 0
+
+    def publish(self, fragment: AnchoredFragment) -> None:
+        self.fragment = fragment
+        self._v += 1
+        try:
+            self.version.set_notify(self._v)
+        except Exception:
+            self.version._value = self._v
+
+
+async def chain_sync_client(session, kernel, candidate: CandidateState,
+                            window: int = 32) -> None:
+    """Pipelined ChainSync client against `session` (CLIENT role,
+    PipelinedSession).  Publishes validated headers into `candidate`;
+    raises ChainSyncClientError to kill the connection.
+    """
+    db = kernel.chain_db
+    protocol = kernel.protocol
+
+    # -- find intersection with our current chain ----------------------------
+    points = db.current_chain.select_points(_OFFSETS)
+    if db.current_chain.anchor not in points:
+        points.append(db.current_chain.anchor)
+    await session.send(MsgFindIntersect(tuple(points)))
+    reply = await session.recv()
+    if isinstance(reply, MsgIntersectNotFound):
+        raise ChainSyncClientError("no intersection with peer chain")
+    assert isinstance(reply, MsgIntersectFound)
+    isect: Point = reply.point
+
+    # Seed the header-state history with ALL of the ledger DB's recent
+    # states up to the intersection (not just the intersection's), so a
+    # legitimate rollback to a point *before* the intersection — a fork
+    # whose branch point predates where we joined the peer — still rewinds
+    # instead of killing the peer (the reference seeds from
+    # HeaderStateHistory of the last k states for exactly this reason).
+    past = db.ledger_db.past_points()
+    if isect not in past:
+        raise ChainSyncClientError(
+            f"intersection {isect} deeper than our ledger history")
+    seed_points = past[:past.index(isect) + 1]
+    history = HeaderStateHistory(
+        protocol.security_param, db.ledger_db.state_at(seed_points[0]).header)
+    for p in seed_points[1:]:
+        history.append(db.ledger_db.state_at(p).header)
+
+    anchor_bn = _block_no_at(db, isect)
+    fragment = AnchoredFragment(isect, (), anchor_block_no=anchor_bn)
+    candidate.publish(fragment.copy())
+
+    buffered: list = []          # validated-pending roll-forward headers
+
+    def flush() -> None:
+        """Validate `buffered` as one batched window and publish."""
+        if not buffered:
+            return
+        res = validate_headers_batched(
+            protocol, buffered, history.current,
+            lambda i, h: kernel.ledger_view(), backend=kernel.backend)
+        for st, h in zip(res.states, buffered[:res.n_valid]):
+            history.append(st)
+            fragment.add_block(h)
+        del buffered[:]
+        if res.n_valid:
+            candidate.publish(fragment.copy())
+        if res.error is not None:
+            raise ChainSyncClientError(f"invalid header from peer: "
+                                       f"{res.error}")
+
+    # -- pipelined follow loop ------------------------------------------------
+    while True:
+        while session.outstanding < window:
+            await session.send_pipelined(MsgRequestNext(), "StIdle")
+        msg = await session.collect()
+        if isinstance(msg, MsgAwaitReply):
+            # caught up: validate what we have, then wait for the next
+            # server push (the collect below blocks on the channel)
+            flush()
+            continue
+        if isinstance(msg, MsgRollForward):
+            buffered.append(msg.header)
+            if len(buffered) >= window:
+                flush()
+            elif session.outstanding == 0:
+                flush()
+            continue
+        if isinstance(msg, MsgRollBackward):
+            flush()
+            if not history.rewind(msg.point):
+                raise ChainSyncClientError(
+                    f"peer rolled back beyond k to {msg.point}")
+            if not fragment.truncate_to(msg.point):
+                # rollback target is before the candidate's anchor but
+                # within our header history: re-anchor an empty fragment
+                # there (the peer's new chain branches below where we
+                # joined it)
+                bn = history.current.tip.block_no \
+                    if history.current.tip else -1
+                fragment = AnchoredFragment(msg.point, (),
+                                            anchor_block_no=bn)
+            candidate.publish(fragment.copy())
+            continue
+        raise ChainSyncClientError(f"unexpected message {msg}")
+
+
+def _block_no_at(db, point: Point) -> int:
+    if point.is_genesis:
+        return -1
+    blk = db.current_chain.lookup(point.hash)
+    if blk is not None:
+        return blk.block_no
+    if point == db.current_chain.anchor:
+        return db.current_chain.anchor_block_no
+    raise ChainSyncClientError(f"intersection {point} not on our chain")
+
+
+async def chain_sync_server(session, chain_db) -> None:
+    """ChainSync server from a ChainDB follower (ChainSync/Server.hs).
+
+    Serves headers of the current chain; blocks on the ChainDB version TVar
+    when the follower is caught up (followerInstructionBlocking).
+    """
+    from ..network.protocols.chainsync import (
+        MsgDone, MsgIntersectFound, MsgIntersectNotFound, MsgRequestNext,
+    )
+    follower = chain_db.new_follower()
+    try:
+        while True:
+            msg = await session.recv()
+            if isinstance(msg, MsgDone):
+                return
+            if isinstance(msg, MsgFindIntersect):
+                found = None
+                for p in msg.points:
+                    if p.is_genesis or chain_db.contains_point(p):
+                        found = p
+                        break
+                tip = _tip_of(chain_db)
+                if found is None:
+                    await session.send(MsgIntersectNotFound(tip))
+                else:
+                    follower.point = found
+                    follower.needs_rollback = False
+                    await session.send(MsgIntersectFound(found, tip))
+                continue
+            assert isinstance(msg, MsgRequestNext)
+            ins = follower.instruction()
+            if ins is None:
+                await session.send(MsgAwaitReply())
+                while True:
+                    # read the version BEFORE re-checking the instruction so
+                    # a block added in between is seen here, not lost to the
+                    # wait below (same lost-wakeup discipline as the example
+                    # server in network/protocols/chainsync.py)
+                    seen = kernel_version_value(chain_db)
+                    ins = follower.instruction()
+                    if ins is not None:
+                        break
+                    await _wait_version_above(chain_db, seen)
+            kind, payload = ins
+            tip = _tip_of(chain_db)
+            if kind == "forward":
+                await session.send(MsgRollForward(payload.header, tip))
+            else:
+                await session.send(MsgRollBackward(payload, tip))
+    finally:
+        chain_db.remove_follower(follower)
+
+
+def _tip_of(chain_db):
+    from ..chain.block import Tip
+    return Tip(chain_db.tip_point(), chain_db.current_chain.head_block_no)
+
+
+def kernel_version_value(chain_db) -> int:
+    tv = getattr(chain_db, "version_tvar", None)
+    return tv.value if tv is not None else chain_db.version
+
+
+async def _wait_version_above(chain_db, seen: int) -> None:
+    tv = getattr(chain_db, "version_tvar", None)
+    if tv is None:
+        # no STM hook (ChainDB used outside a kernel): cooperative poll
+        while chain_db.version == seen:
+            await sim.yield_()
+        return
+
+    def tx_fn(tx):
+        if tx.read(tv) == seen:
+            raise Retry()
+    await sim.atomically(tx_fn)

@@ -1,0 +1,287 @@
+"""NodeKernel — ties ChainDB, mempool, forging, and peers together.
+
+Reference: ouroboros-consensus/src/Ouroboros/Consensus/NodeKernel.hs:87
+(`NodeKernel` record), :139-175 (initNodeKernel forks block-forging threads
++ BlockFetch logic + candidate-fragment map), :344-496 (the forging loop:
+slot tick → checkShouldForge → mempool snapshot → forgeBlock →
+addBlockAsync), plus the connection assembly of Network/NodeToNode.hs
+(mkApps: per-protocol handlers over one mux bearer, protocol numbers
+chainsync=2 blockfetch=3 txsubmission=4 — NodeToNode.hs:211,382).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+from .. import simharness as sim
+from ..chain.block import GENESIS_HASH
+from ..consensus.headers import ProtocolBlock, ProtocolHeader, body_hash_of
+from ..consensus.mempool import Mempool
+from ..network.mux import (
+    INITIATOR, RESPONDER, CodecChannel, Mux, bearer_pair,
+)
+from ..network.protocols import blockfetch as bf_proto
+from ..network.protocols import chainsync as cs_proto
+from ..network.protocols import txsubmission as tx_proto
+from ..network.typed import CLIENT, PipelinedSession, SERVER, Session
+from ..simharness import TVar
+from .block_fetch import (
+    PeerFetchState, block_fetch_client, block_fetch_server, fetch_logic_loop,
+)
+from .blockchain_time import BlockchainTime
+from .chain_sync import CandidateState, chain_sync_client, chain_sync_server
+from .tx_submission import tx_inbound_loop, tx_outbound_loop
+
+CHAINSYNC_NUM, BLOCKFETCH_NUM, TXSUBMISSION_NUM = 2, 3, 4
+
+
+@dataclass
+class BlockForging:
+    """One forging credential (Block/Forging.hs:81-183).
+
+    forge(protocol, is_leader_proof, header) -> signed header."""
+    issuer: int
+    can_be_leader: Any
+    forge: Callable
+
+
+class NodeKernel:
+    """One node: storage + mempool + forging + peer connections."""
+
+    def __init__(self, chain_db, ledger_rules, mempool: Optional[Mempool],
+                 btime: BlockchainTime, forgings=(), label: str = "node",
+                 backend=None, chain_sync_window: int = 32,
+                 header_decode=None, block_decode_obj=None, tx_decode=None):
+        self.chain_db = chain_db
+        self.ledger_rules = ledger_rules
+        self.protocol = chain_db.ext_rules.protocol
+        self.mempool = mempool
+        self.btime = btime
+        self.forgings = list(forgings)
+        self.label = label
+        self.backend = backend
+        self.chain_sync_window = chain_sync_window
+        self.header_decode = header_decode
+        self.block_decode_obj = block_decode_obj
+        self.tx_decode = tx_decode
+
+        self.candidates: Dict[object, CandidateState] = {}
+        self.peer_fetch: Dict[object, PeerFetchState] = {}
+        self.fetch_wakeup = TVar(0, label=f"{label}-fetch-wakeup")
+        self._fetch_v = 0
+        self._threads: list = []
+
+        # STM hook for followers / servers blocking on chain changes
+        chain_db.version_tvar = TVar(chain_db.version,
+                                     label=f"{label}-chain-version")
+        chain_db.on_change(self._on_chain_change)
+
+    # -- wiring ---------------------------------------------------------------
+    def _on_chain_change(self) -> None:
+        try:
+            self.chain_db.version_tvar.set_notify(self.chain_db.version)
+        except Exception:
+            self.chain_db.version_tvar._value = self.chain_db.version
+        if self.mempool is not None:
+            self.mempool.sync_with_ledger()
+        self.poke_fetch_logic()
+
+    def poke_fetch_logic(self) -> None:
+        self._fetch_v += 1
+        try:
+            self.fetch_wakeup.set_notify(self._fetch_v)
+        except Exception:
+            self.fetch_wakeup._value = self._fetch_v
+
+    def ledger_view(self):
+        return self.ledger_rules.ledger_view(self.chain_db.current_ledger.ledger)
+
+    def have_block(self, h: bytes) -> bool:
+        db = self.chain_db
+        return db.volatile.block_info(h) is not None or h in db.immutable
+
+    def plausible_candidate(self, frag) -> bool:
+        """Would we prefer this candidate over our current chain?
+        (Decision.hs plausible-candidates filter; select-view comparison.)"""
+        head = frag.head
+        if head is None:
+            return False
+        cur = self.chain_db.current_chain
+        cur_head = cur.head
+        cur_view = (self.protocol.select_view(cur_head.header)
+                    if cur_head is not None else cur.head_block_no)
+        return self.protocol.prefer_candidate(
+            cur_view, self.protocol.select_view(head))
+
+    def add_fetched_block(self, block) -> None:
+        self.chain_db.add_block(block)
+
+    def new_candidate(self, peer_id) -> CandidateState:
+        c = CandidateState(peer_id)
+        orig = c.publish
+
+        def publish(fragment):
+            orig(fragment)
+            self.poke_fetch_logic()
+        c.publish = publish
+        self.candidates[peer_id] = c
+        return c
+
+    def drop_peer(self, peer_id) -> None:
+        self.candidates.pop(peer_id, None)
+        self.peer_fetch.pop(peer_id, None)
+        self.poke_fetch_logic()
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> None:
+        """Fork the background threads (initNodeKernel, NodeKernel.hs:139)."""
+        self.btime.start(label=f"{self.label}-btime")
+        self._threads.append(sim.spawn(fetch_logic_loop(self),
+                                       label=f"{self.label}-fetch-logic"))
+        for forging in self.forgings:
+            self._threads.append(
+                sim.spawn(self._forging_loop(forging),
+                          label=f"{self.label}-forge-{forging.issuer}"))
+
+    def stop(self) -> None:
+        self.btime.stop()
+        for t in self._threads:
+            t.cancel()
+        self._threads.clear()
+
+    # -- forging (NodeKernel.hs:344-496) --------------------------------------
+    async def _forging_loop(self, forging: BlockForging) -> None:
+        last = self.btime.current.value - 1
+        while True:
+            slot = await self.btime.wait_slot_after(last)
+            last = slot
+            try:
+                self._try_forge(forging, slot)
+            except Exception as e:
+                sim.trace_event(("forge-error", self.label, slot, repr(e)))
+
+    def _try_forge(self, forging: BlockForging, slot: int) -> None:
+        ext = self.chain_db.current_ledger
+        view = self.ledger_rules.ledger_view(ext.ledger)
+        ticked_dep = self.protocol.tick_chain_dep_state(
+            ext.header.chain_dep_state, view, slot)
+        proof = self.protocol.check_is_leader(
+            forging.can_be_leader, slot, ticked_dep, view)
+        if proof is None:
+            return
+        if self.mempool is not None:
+            ticked_ledger = self.ledger_rules.tick(ext.ledger, slot)
+            snap = self.mempool.get_snapshot_for(slot, ticked_ledger)
+            body = tuple(snap.txs)
+        else:
+            body = ()
+        # Build on the validated tip from the ledger state, NOT the chain
+        # fragment: after copy-to-immutable empties the fragment the anchor
+        # is a real block, and forging prev=GENESIS there would waste every
+        # led slot on an unconnectable block.
+        ann = ext.header.tip
+        if ann is None:
+            prev_hash, block_no = GENESIS_HASH, 0
+        else:
+            prev_hash, block_no = ann.hash, ann.block_no + 1
+        hdr = ProtocolHeader(slot=slot, block_no=block_no,
+                             prev_hash=prev_hash,
+                             body_hash=body_hash_of(body),
+                             issuer=forging.issuer)
+        signed = forging.forge(self.protocol, proof, hdr)
+        block = ProtocolBlock(signed, body)
+        res = self.chain_db.add_block(block)
+        sim.trace_event(("forged", self.label, slot, res.kind))
+
+
+def connect_nodes(a: NodeKernel, b: NodeKernel, delay: float = 0.0,
+                  sdu_size: int = 12288) -> None:
+    """Wire a<->b with two directional connections (the ThreadNet mesh edge,
+    Test/ThreadNet/Network.hs:275-344): each direction runs its own bearer,
+    mux, and initiator/responder protocol set."""
+    _connect_directional(a, b, delay, sdu_size)
+    _connect_directional(b, a, delay, sdu_size)
+
+
+def _connect_directional(initiator: NodeKernel, responder: NodeKernel,
+                         delay: float, sdu_size: int) -> None:
+    """initiator runs chainsync/blockfetch clients against responder's
+    servers (learning responder's chain) and offers its txs to responder's
+    inbound (NodeToNode.hs initiator/responder application split)."""
+    peer_id = f"{initiator.label}->{responder.label}"
+    bi, br = bearer_pair(sdu_size=sdu_size, delay=delay)
+    mux_i = Mux(bi, f"{peer_id}.mux-i")
+    mux_r = Mux(br, f"{peer_id}.mux-r")
+    mux_i.start()
+    mux_r.start()
+
+    hdr_dec = initiator.header_decode
+    blk_dec = initiator.block_decode_obj
+    cs_codec = cs_proto.make_codec(hdr_dec) if hdr_dec else cs_proto.CODEC
+    bf_codec = bf_proto.make_codec(blk_dec) if blk_dec else bf_proto.CODEC
+
+    candidate = initiator.new_candidate(peer_id)
+    initiator.peer_fetch[peer_id] = PeerFetchState(peer_id)
+
+    # initiator side
+    cs_sess = PipelinedSession(
+        cs_proto.SPEC, CLIENT,
+        CodecChannel(mux_i.channel(CHAINSYNC_NUM, INITIATOR), cs_codec),
+        max_outstanding=initiator.chain_sync_window + 2)
+    initiator._threads.append(sim.spawn(
+        _supervise_chain_sync(initiator, cs_sess, candidate, peer_id),
+        label=f"{peer_id}.cs-client"))
+
+    bf_sess = Session(
+        bf_proto.SPEC, CLIENT,
+        CodecChannel(mux_i.channel(BLOCKFETCH_NUM, INITIATOR), bf_codec))
+    initiator._threads.append(sim.spawn(
+        block_fetch_client(bf_sess, initiator, peer_id),
+        label=f"{peer_id}.bf-client"))
+
+    # responder side
+    cs_srv = Session(
+        cs_proto.SPEC, SERVER,
+        CodecChannel(mux_r.channel(CHAINSYNC_NUM, RESPONDER), cs_codec))
+    responder._threads.append(sim.spawn(
+        chain_sync_server(cs_srv, responder.chain_db),
+        label=f"{peer_id}.cs-server"))
+
+    bf_srv = Session(
+        bf_proto.SPEC, SERVER,
+        CodecChannel(mux_r.channel(BLOCKFETCH_NUM, RESPONDER), bf_codec))
+    responder._threads.append(sim.spawn(
+        block_fetch_server(responder.chain_db)(bf_srv),
+        label=f"{peer_id}.bf-server"))
+
+    # tx submission: initiator offers its mempool; responder collects
+    if initiator.mempool is not None and responder.mempool is not None \
+            and responder.tx_decode is not None:
+        tx_out = Session(
+            tx_proto.SPEC, CLIENT,
+            CodecChannel(mux_i.channel(TXSUBMISSION_NUM, INITIATOR),
+                         tx_proto.CODEC))
+        initiator._threads.append(sim.spawn(
+            tx_outbound_loop(tx_out, initiator.mempool),
+            label=f"{peer_id}.tx-out"))
+        tx_in = Session(
+            tx_proto.SPEC, SERVER,
+            CodecChannel(mux_r.channel(TXSUBMISSION_NUM, RESPONDER),
+                         tx_proto.CODEC))
+        responder._threads.append(sim.spawn(
+            tx_inbound_loop(tx_in, responder.mempool, responder.tx_decode),
+            label=f"{peer_id}.tx-in"))
+
+
+async def _supervise_chain_sync(kernel: NodeKernel, session, candidate,
+                                peer_id) -> None:
+    """Run the ChainSync client; on error drop the peer's candidate so
+    BlockFetch stops considering it (the kill-the-connection semantics of
+    Client.hs:1114, minus reconnection policy)."""
+    from .chain_sync import ChainSyncClientError
+    try:
+        await chain_sync_client(session, kernel, candidate,
+                                window=kernel.chain_sync_window)
+    except ChainSyncClientError as e:
+        sim.trace_event(("chain-sync-kill", kernel.label, peer_id, str(e)))
+        kernel.drop_peer(peer_id)

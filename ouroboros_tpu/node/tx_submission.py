@@ -1,0 +1,77 @@
+"""Node-side TxSubmission: blocking outbound from the mempool, inbound to
+the mempool.
+
+Reference: ouroboros-network/src/Ouroboros/Network/TxSubmission/
+{Outbound,Inbound}.hs + Mempool/Reader.hs — the outbound side serves tx
+ids/bodies from a mempool reader, *blocking* on the blocking id request
+until new txs arrive; the inbound side windows requests, dedups, and feeds
+`mempoolAddTxs`.
+"""
+from __future__ import annotations
+
+from .. import simharness as sim
+from ..network.protocols.txsubmission import (
+    MsgReplyTxIds, MsgReplyTxs, MsgRequestTxIds, MsgRequestTxs,
+)
+from ..simharness import Retry
+from ..utils import cbor
+
+
+async def tx_outbound_loop(session, mempool) -> None:
+    """CLIENT role: serve our mempool to the peer's inbound server.
+
+    Blocking MsgRequestTxIds waits on the mempool version TVar when the
+    reader is drained (Outbound.hs blocking semantics) instead of
+    terminating — this is a long-lived node-to-node connection.
+    """
+    reader = mempool.reader()
+    while True:
+        msg = await session.recv()
+        if isinstance(msg, MsgRequestTxIds):
+            new = reader.next_ids(msg.req)
+            if not new and msg.blocking:
+                while not new:
+                    seen = mempool.version.value
+                    new = reader.next_ids(msg.req)
+                    if new:
+                        break
+
+                    def wait_change(tx, seen=seen):
+                        if tx.read(mempool.version) == seen:
+                            raise Retry()
+                    await sim.atomically(wait_change)
+            await session.send(MsgReplyTxIds(tuple(new)))
+        elif isinstance(msg, MsgRequestTxs):
+            txs = []
+            for txid in msg.ids:
+                tx = reader.lookup(txid)
+                if tx is not None:
+                    txs.append(cbor.dumps(tx.encode()))
+            await session.send(MsgReplyTxs(tuple(txs)))
+        else:
+            return
+
+
+async def tx_inbound_loop(session, mempool, tx_decode, window: int = 10
+                          ) -> None:
+    """SERVER role: pull txs from the peer into our mempool
+    (Inbound.hs:52-172 — windowed acks, dedup via the mempool itself)."""
+    ack = 0
+    while True:
+        await session.send(MsgRequestTxIds(True, ack, window))
+        reply = await session.recv()
+        if not isinstance(reply, MsgReplyTxIds):
+            return
+        ids = [i for i, _ in reply.ids_and_sizes]
+        ack = len(ids)
+        if not ids:
+            continue
+        # skip txs we already have (dedup before fetching bodies); one
+        # snapshot for the whole window, not one per id
+        have = set(mempool.get_snapshot().tx_ids)
+        want = [i for i in ids if i not in have]
+        if want:
+            await session.send(MsgRequestTxs(tuple(want)))
+            reply = await session.recv()
+            txs = [tx_decode(cbor.loads(raw)) for raw in reply.txs]
+            mempool.try_add_txs(txs)

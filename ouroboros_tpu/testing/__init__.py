@@ -1,0 +1,12 @@
+"""Test harness library — the ouroboros-consensus-test analog.
+
+ThreadNet (multi-node network-in-the-simulator) lives here so test suites
+and benchmarks share one harness (reference: ouroboros-consensus-test/src/
+Test/ThreadNet/{General,Network}.hs).
+"""
+from .threadnet import (
+    ThreadNetConfig, ThreadNetResult, praos_node_keys, run_threadnet,
+)
+
+__all__ = ["ThreadNetConfig", "ThreadNetResult", "praos_node_keys",
+           "run_threadnet"]

@@ -1,0 +1,156 @@
+"""Mempool: admission, capacity, revalidation on tip change, reader cursor.
+
+Mirrors the reference's mempool property-test surface
+(ouroboros-consensus-test/test-consensus/Test/Consensus/Mempool.hs):
+all-valid-txs-in, invalid-rejected, snapshot ordering, syncWithLedger
+dropping included txs.
+"""
+import hashlib
+
+from ouroboros_tpu.chain.block import Point
+from ouroboros_tpu.consensus import Mempool
+from ouroboros_tpu.crypto import ed25519_ref
+from ouroboros_tpu.crypto.backend import OpensslBackend
+from ouroboros_tpu.ledgers import MockLedger, TxIn, TxOut, make_tx
+
+BACKEND = OpensslBackend()
+
+
+def _setup(n_keys=3, coin=100):
+    sks = [hashlib.sha256(b"mp-%d" % i).digest() for i in range(n_keys)]
+    vks = [ed25519_ref.public_key(sk) for sk in sks]
+    ledger = MockLedger({vk: coin for vk in vks})
+    state = ledger.initial_state()
+    holder = {"state": state, "tip": Point.genesis()}
+    mp = Mempool(ledger, lambda: (holder["state"], holder["tip"]),
+                 backend=BACKEND)
+    return sks, vks, ledger, holder, mp
+
+
+def _genesis_in(ledger, vks, vk):
+    """TxIn spending vk's genesis output."""
+    ix = sorted(vks_amounts(ledger)).index(vk)
+    return TxIn(MockLedger.GENESIS_TXID, ix)
+
+
+def vks_amounts(ledger):
+    return list(ledger.genesis.keys())
+
+
+def test_add_valid_and_invalid():
+    sks, vks, ledger, holder, mp = _setup()
+    tx_ok = make_tx([_genesis_in(ledger, vks, vks[0])],
+                    [TxOut(vks[1], 100)], [sks[0]])
+    # unsigned spend of key 1's output
+    tx_bad = make_tx([_genesis_in(ledger, vks, vks[1])],
+                     [TxOut(vks[2], 100)], [])
+    added, rejected = mp.try_add_txs([tx_ok, tx_bad])
+    assert added == [tx_ok.txid]
+    assert len(rejected) == 1 and rejected[0][0] is tx_bad
+    snap = mp.get_snapshot()
+    assert snap.tx_ids == [tx_ok.txid]
+
+
+def test_chained_txs_and_double_spend():
+    sks, vks, ledger, holder, mp = _setup()
+    tx1 = make_tx([_genesis_in(ledger, vks, vks[0])],
+                  [TxOut(vks[1], 100)], [sks[0]])
+    # tx2 spends tx1's output — valid only with tx1 in the pool
+    tx2 = make_tx([TxIn(tx1.txid, 0)], [TxOut(vks[2], 60),
+                                        TxOut(vks[1], 40)], [sks[1]])
+    # tx3 double-spends the same genesis output as tx1
+    tx3 = make_tx([_genesis_in(ledger, vks, vks[0])],
+                  [TxOut(vks[2], 100)], [sks[0]])
+    added, rejected = mp.try_add_txs([tx1, tx2, tx3])
+    assert added == [tx1.txid, tx2.txid]
+    assert rejected[0][0] is tx3
+    assert "missing input" in str(rejected[0][1])
+
+
+def test_duplicate_rejected():
+    sks, vks, ledger, holder, mp = _setup()
+    tx = make_tx([_genesis_in(ledger, vks, vks[0])],
+                 [TxOut(vks[1], 100)], [sks[0]])
+    mp.try_add_txs([tx])
+    added, rejected = mp.try_add_txs([tx])
+    assert not added and "duplicate" in str(rejected[0][1])
+
+
+def test_capacity_bound():
+    sks, vks, ledger, holder, mp = _setup()
+    mp.capacity_bytes = 200          # roomy enough for ~1 tx only (~178 B)
+    tx1 = make_tx([_genesis_in(ledger, vks, vks[0])],
+                  [TxOut(vks[1], 100)], [sks[0]])
+    tx2 = make_tx([_genesis_in(ledger, vks, vks[1])],
+                  [TxOut(vks[2], 100)], [sks[1]])
+    added, rejected = mp.try_add_txs([tx1, tx2])
+    assert added == [tx1.txid]
+    assert "full" in str(rejected[0][1])
+
+
+def test_sync_with_ledger_drops_included():
+    """Txs included in a new tip block vanish on syncWithLedger."""
+    sks, vks, ledger, holder, mp = _setup()
+    tx1 = make_tx([_genesis_in(ledger, vks, vks[0])],
+                  [TxOut(vks[1], 100)], [sks[0]])
+    tx2 = make_tx([_genesis_in(ledger, vks, vks[1])],
+                  [TxOut(vks[2], 100)], [sks[1]])
+    mp.try_add_txs([tx1, tx2])
+
+    # "adopt a block" containing tx1: advance the ledger by hand
+    class _B:
+        body = (tx1,)
+        slot = 1
+        hash = b"\x01" * 32
+    new_state = ledger._apply_txs(ledger.tick(holder["state"], 1), _B())
+    holder["state"] = new_state
+    holder["tip"] = Point(1, _B.hash)
+
+    dropped = mp.sync_with_ledger()
+    assert dropped == [tx1.txid]
+    assert mp.get_snapshot().tx_ids == [tx2.txid]
+    # tx2 revalidated against the new base
+    assert mp.get_snapshot().ledger_state.utxo_dict() != new_state.utxo_dict()
+
+
+def test_remove_txs():
+    sks, vks, ledger, holder, mp = _setup()
+    tx1 = make_tx([_genesis_in(ledger, vks, vks[0])],
+                  [TxOut(vks[1], 100)], [sks[0]])
+    tx2 = make_tx([TxIn(tx1.txid, 0)], [TxOut(vks[2], 100)], [sks[1]])
+    mp.try_add_txs([tx1, tx2])
+    # removing tx1 invalidates tx2 (chained) during revalidation
+    mp.remove_txs([tx1.txid])
+    assert mp.get_snapshot().tx_ids == []
+
+
+def test_snapshot_for_ticked_state():
+    sks, vks, ledger, holder, mp = _setup()
+    tx = make_tx([_genesis_in(ledger, vks, vks[0])],
+                 [TxOut(vks[1], 100)], [sks[0]])
+    mp.try_add_txs([tx])
+    ticked = ledger.tick(holder["state"], 5)
+    snap = mp.get_snapshot_for(5, ticked)
+    assert snap.tx_ids == [tx.txid]
+    assert snap.slot == 5
+    # the snapshot state has the tx applied
+    assert (tx.txid, 0) in snap.ledger_state.utxo_dict()
+
+
+def test_reader_cursor():
+    sks, vks, ledger, holder, mp = _setup()
+    r = mp.reader()
+    assert r.next_ids(5) == []
+    tx1 = make_tx([_genesis_in(ledger, vks, vks[0])],
+                  [TxOut(vks[1], 100)], [sks[0]])
+    tx2 = make_tx([_genesis_in(ledger, vks, vks[1])],
+                  [TxOut(vks[2], 100)], [sks[1]])
+    mp.try_add_txs([tx1])
+    ids = r.next_ids(5)
+    assert [i for i, _ in ids] == [tx1.txid]
+    mp.try_add_txs([tx2])
+    ids = r.next_ids(5)
+    assert [i for i, _ in ids] == [tx2.txid]      # cursor advanced past tx1
+    assert r.next_ids(5) == []
+    assert r.lookup(tx1.txid) is tx1
+    assert r.lookup(b"\x00" * 32) is None

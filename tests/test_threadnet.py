@@ -1,0 +1,92 @@
+"""ThreadNet: multi-node mock-Praos networks in the deterministic simulator.
+
+Reference: Test/ThreadNet/{General,Network}.hs + the mock-Praos
+instantiation (ouroboros-consensus-mock-test/test/Test/ThreadNet/Praos.hs).
+prop_general's checks map to: convergence (bounded fork length), chain
+growth, and no unexpected thread failures.  This is BASELINE.md config #1.
+"""
+import pytest
+
+from ouroboros_tpu.ledgers import TxIn, TxOut, make_tx
+from ouroboros_tpu.ledgers.mock import MockLedger
+from ouroboros_tpu.testing import ThreadNetConfig, run_threadnet
+
+
+def _no_failures(result):
+    assert not result.failures, f"thread failures: {result.failures}"
+
+
+def test_two_nodes_converge():
+    cfg = ThreadNetConfig(n_nodes=2, n_slots=20, k=10, f=0.5, seed=1)
+    res = run_threadnet(cfg)
+    _no_failures(res)
+    assert res.min_length() >= 3, "chain did not grow"
+    assert res.common_prefix_ok(cfg.k)
+    # quiet network: only end-of-run slot battles may diverge
+    assert res.max_fork_depth() <= 3, f"fork too deep: {res.max_fork_depth()}"
+
+
+def test_three_nodes_mesh_converge():
+    cfg = ThreadNetConfig(n_nodes=3, n_slots=30, k=10, f=0.6, seed=2)
+    res = run_threadnet(cfg)
+    _no_failures(res)
+    assert res.min_length() >= 5
+    assert res.common_prefix_ok(cfg.k)
+    assert res.max_fork_depth() <= 4, f"fork too deep: {res.max_fork_depth()}"
+
+
+def test_late_join_syncs():
+    """A node joining mid-run must sync the existing chain (the node-join
+    plan machinery, Util/NodeJoinPlan.hs)."""
+    cfg = ThreadNetConfig(n_nodes=3, n_slots=40, k=20, f=0.5, seed=3,
+                          join_slots=[0, 0, 20])
+    res = run_threadnet(cfg)
+    _no_failures(res)
+    assert res.common_prefix_ok(cfg.k)
+    late = res.chains[2]
+    assert late.head_block_no >= 3, "late joiner did not sync"
+    assert res.max_fork_depth() <= 4, f"fork too deep: {res.max_fork_depth()}"
+
+
+def test_ring_topology_converges():
+    cfg = ThreadNetConfig(n_nodes=4, n_slots=40, k=20, f=0.5, seed=4,
+                          topology="ring")
+    res = run_threadnet(cfg)
+    _no_failures(res)
+    assert res.common_prefix_ok(cfg.k)
+    assert res.max_fork_depth() <= 4, f"fork too deep: {res.max_fork_depth()}"
+
+
+def test_txs_diffuse_and_land_in_blocks():
+    """A tx submitted at one node reaches others via TxSubmission and ends
+    up in a forged block, mutating every node's final UTxO."""
+    def tx_factory(keys, ledger_state):
+        # spend node 0's genesis output to node 1
+        utxo = ledger_state.utxo_dict()
+        gen = MockLedger.GENESIS_TXID
+        for (txid, ix), (addr, amount) in sorted(utxo.items()):
+            if txid == gen and addr == keys[0].payment_vk:
+                return make_tx([TxIn(txid, ix)],
+                               [TxOut(keys[1].payment_vk, amount)],
+                               [keys[0].payment_sk])
+        raise AssertionError("genesis output for node 0 not found")
+
+    cfg = ThreadNetConfig(n_nodes=3, n_slots=40, k=20, f=0.5, seed=5,
+                          tx_plan=((5, 0, tx_factory),))
+    res = run_threadnet(cfg)
+    _no_failures(res)
+    assert res.max_fork_depth() <= 4
+    for ext in res.ledgers:
+        utxo = ext.ledger.utxo_dict()
+        owners = [addr for (_txid, _ix), (addr, _amt) in utxo.items()]
+        # node 0's genesis coin moved to node 1
+        assert owners.count(res.keys[1].payment_vk) == 2
+        assert owners.count(res.keys[0].payment_vk) == 0
+
+
+def test_determinism_same_seed_same_chains():
+    cfg = ThreadNetConfig(n_nodes=3, n_slots=20, k=10, f=0.6, seed=7)
+    r1 = run_threadnet(cfg)
+    r2 = run_threadnet(cfg)
+    assert [c.head_point for c in r1.chains] == \
+           [c.head_point for c in r2.chains]
