@@ -72,12 +72,14 @@ async def server(session):
         await session.send(MsgKeepAliveResponse(msg.cookie))
 
 
-async def client_probe(session, rounds: int, interval: float,
+async def client_probe(session, rounds, interval: float,
                        on_rtt=None):
     """Probe loop: send cookie, measure virtual RTT, report to on_rtt
-    (the DeltaQ feed)."""
+    (the DeltaQ feed, KeepAlive.hs:41-55).  rounds=None probes forever
+    (the node's long-lived keep-alive)."""
     rtts = []
-    for cookie in range(rounds):
+    cookie = 0
+    while rounds is None or cookie < rounds:
         t0 = sim.now()
         await session.send(MsgKeepAlive(cookie & 0xFFFF))
         reply = await session.recv()
@@ -87,7 +89,9 @@ async def client_probe(session, rounds: int, interval: float,
         rtts.append(rtt)
         if on_rtt:
             on_rtt(rtt)
-        if cookie != rounds - 1:
-            await sim.sleep(interval)
+        cookie += 1
+        if rounds is not None and cookie == rounds:
+            break
+        await sim.sleep(interval)
     await session.send(MsgDone())
     return rtts

@@ -60,7 +60,9 @@ def fetch_decisions(
         peer_states: Dict[object, PeerFetchState],
         plausible: Callable[[object], bool],
         have_block: Callable[[bytes], bool],
-        max_blocks_per_request: int = 16) -> list[FetchRequest]:
+        max_blocks_per_request: int = 16,
+        order_key: Optional[Callable[[object], float]] = None
+        ) -> list[FetchRequest]:
     """The pure decision pipeline (Decision.hs:150-184).
 
     candidates: peer -> AnchoredFragment of validated headers (or None).
@@ -79,11 +81,13 @@ def fetch_decisions(
             claimed |= {h.hash for h in req.headers}
 
     decisions: list[FetchRequest] = []
-    # deterministic peer order: better candidates first, then peer id
+    # deterministic peer order: better candidates first, then cheaper peers
+    # by DeltaQ expected fetch time (Decision.hs prioritisation), then id
     def head_key(item):
         peer, frag = item
         bn = frag.head_block_no if frag is not None and len(frag) else -1
-        return (-bn, str(peer))
+        dq = order_key(peer) if order_key is not None else 0.0
+        return (-bn, dq, str(peer))
 
     for peer, frag in sorted(candidates.items(), key=head_key):
         if frag is None or len(frag) == 0 or not plausible(frag):
@@ -161,7 +165,8 @@ async def fetch_logic_loop(kernel) -> None:
             {p: c.fragment for p, c in kernel.candidates.items()},
             kernel.peer_fetch,
             kernel.plausible_candidate,
-            kernel.have_block)
+            kernel.have_block,
+            order_key=kernel.fetch_order_key)
         for req in decisions:
             ps = kernel.peer_fetch[req.peer_id]
             ps.in_flight |= {h.hash for h in req.headers}
@@ -189,7 +194,12 @@ async def block_fetch_client(session, kernel, peer_id) -> None:
         while True:
             req = await sim.atomically(lambda tx: ps.queue.get(tx))
             try:
+                t0 = sim.now()
                 blocks = await fetch_range(session, req.start, req.end)
+                tracker = kernel.peer_gsv.get(peer_id)
+                if tracker is not None and blocks:
+                    tracker.observe_transfer(
+                        sum(len(b.bytes) for b in blocks), sim.now() - t0)
                 for b in blocks or ():
                     kernel.add_fetched_block(b)
             finally:

@@ -20,8 +20,12 @@ from ..consensus.mempool import Mempool
 from ..network.mux import (
     INITIATOR, RESPONDER, CodecChannel, Mux, bearer_pair,
 )
+from ..network import node_to_node as n2n
+from ..network.deltaq import PeerGSVTracker
 from ..network.protocols import blockfetch as bf_proto
 from ..network.protocols import chainsync as cs_proto
+from ..network.protocols import handshake as hs_proto
+from ..network.protocols import keepalive as ka_proto
 from ..network.protocols import txsubmission as tx_proto
 from ..network.typed import CLIENT, PipelinedSession, SERVER, Session
 from ..simharness import TVar
@@ -32,7 +36,9 @@ from .blockchain_time import BlockchainTime
 from .chain_sync import CandidateState, chain_sync_client, chain_sync_server
 from .tx_submission import tx_inbound_loop, tx_outbound_loop
 
-CHAINSYNC_NUM, BLOCKFETCH_NUM, TXSUBMISSION_NUM = 2, 3, 4
+# protocol numbers per NodeToNode.hs:211-212 (handshake=0, chainsync=2,
+# blockfetch=3, txsubmission=4, keepalive=8)
+CHAINSYNC_NUM, BLOCKFETCH_NUM, TXSUBMISSION_NUM, KEEPALIVE_NUM = 2, 3, 4, 8
 
 
 @dataclass
@@ -67,6 +73,9 @@ class NodeKernel:
 
         self.candidates: Dict[object, CandidateState] = {}
         self.peer_fetch: Dict[object, PeerFetchState] = {}
+        self.peer_gsv: Dict[object, PeerGSVTracker] = {}
+        self.keepalive_interval = 10.0
+        self.network_magic = 0
         self.fetch_wakeup = TVar(0, label=f"{label}-fetch-wakeup")
         self._fetch_v = 0
         self._threads: list = []
@@ -130,18 +139,41 @@ class NodeKernel:
     def drop_peer(self, peer_id) -> None:
         self.candidates.pop(peer_id, None)
         self.peer_fetch.pop(peer_id, None)
+        self.peer_gsv.pop(peer_id, None)
         self.poke_fetch_logic()
+
+    def fetch_order_key(self, peer_id) -> float:
+        """Expected time to fetch a reference-sized batch from this peer
+        (the DeltaQ comparison of Decision.hs prioritisation)."""
+        t = self.peer_gsv.get(peer_id)
+        return t.expected_fetch_time(16 * 2048) if t is not None else 0.0
 
     # -- lifecycle ------------------------------------------------------------
     def start(self) -> None:
-        """Fork the background threads (initNodeKernel, NodeKernel.hs:139)."""
+        """Fork the background threads (initNodeKernel, NodeKernel.hs:139,
+        + the ChainDB background pipeline, Background.hs:84-102)."""
         self.btime.start(label=f"{self.label}-btime")
         self._threads.append(sim.spawn(fetch_logic_loop(self),
                                        label=f"{self.label}-fetch-logic"))
+        self._threads.append(sim.spawn(self._background_loop(),
+                                       label=f"{self.label}-chaindb-bg"))
         for forging in self.forgings:
             self._threads.append(
                 sim.spawn(self._forging_loop(forging),
                           label=f"{self.label}-forge-{forging.issuer}"))
+
+    async def _background_loop(self) -> None:
+        """copyAndSnapshotRunner: whenever the chain grows past k, copy the
+        excess to the ImmutableDB, GC the VolatileDB, snapshot the ledger
+        (all inside ChainDB.copy_to_immutable)."""
+        from .chain_sync import _wait_version_above, kernel_version_value
+        while True:
+            seen = kernel_version_value(self.chain_db)
+            copied = self.chain_db.copy_to_immutable()
+            if copied:
+                sim.trace_event(("copy-to-immutable", self.label, copied))
+                continue
+            await _wait_version_above(self.chain_db, seen)
 
     def stop(self) -> None:
         self.btime.stop()
@@ -207,13 +239,43 @@ def _connect_directional(initiator: NodeKernel, responder: NodeKernel,
                          delay: float, sdu_size: int) -> None:
     """initiator runs chainsync/blockfetch clients against responder's
     servers (learning responder's chain) and offers its txs to responder's
-    inbound (NodeToNode.hs initiator/responder application split)."""
+    inbound (NodeToNode.hs initiator/responder application split).
+
+    Version negotiation runs FIRST, on protocol 0 over the same bearer, and
+    only a successful handshake starts the mini-protocols (Socket.hs:226:
+    negotiate-then-multiplex)."""
     peer_id = f"{initiator.label}->{responder.label}"
     bi, br = bearer_pair(sdu_size=sdu_size, delay=delay)
     mux_i = Mux(bi, f"{peer_id}.mux-i")
     mux_r = Mux(br, f"{peer_id}.mux-r")
     mux_i.start()
     mux_r.start()
+
+    initiator._threads.append(sim.spawn(
+        _run_initiator(initiator, mux_i, peer_id),
+        label=f"{peer_id}.connect-i"))
+    responder._threads.append(sim.spawn(
+        _run_responder(responder, mux_r, peer_id),
+        label=f"{peer_id}.connect-r"))
+
+
+async def _run_initiator(initiator: NodeKernel, mux_i, peer_id) -> None:
+    versions = n2n.node_to_node_versions(initiator.network_magic)
+    hs = Session(
+        hs_proto.SPEC, CLIENT,
+        CodecChannel(mux_i.channel(n2n.HANDSHAKE_NUM, INITIATOR),
+                     hs_proto.CODEC))
+    res = await hs_proto.client_propose(hs, versions)
+    if res[0] != "accepted":
+        sim.trace_event(("handshake-refused", initiator.label, peer_id,
+                         res[1]))
+        return
+    _, version, params = res
+    if dict(params or {}).get("magic") != initiator.network_magic:
+        sim.trace_event(("handshake-magic-mismatch", initiator.label,
+                         peer_id, params))
+        return
+    sim.trace_event(("handshake-ok", initiator.label, peer_id, version))
 
     hdr_dec = initiator.header_decode
     blk_dec = initiator.block_decode_obj
@@ -223,7 +285,6 @@ def _connect_directional(initiator: NodeKernel, responder: NodeKernel,
     candidate = initiator.new_candidate(peer_id)
     initiator.peer_fetch[peer_id] = PeerFetchState(peer_id)
 
-    # initiator side
     cs_sess = PipelinedSession(
         cs_proto.SPEC, CLIENT,
         CodecChannel(mux_i.channel(CHAINSYNC_NUM, INITIATOR), cs_codec),
@@ -239,7 +300,46 @@ def _connect_directional(initiator: NodeKernel, responder: NodeKernel,
         block_fetch_client(bf_sess, initiator, peer_id),
         label=f"{peer_id}.bf-client"))
 
-    # responder side
+    tracker = PeerGSVTracker()
+    initiator.peer_gsv[peer_id] = tracker
+    ka_sess = Session(
+        ka_proto.SPEC, CLIENT,
+        CodecChannel(mux_i.channel(KEEPALIVE_NUM, INITIATOR),
+                     ka_proto.CODEC))
+    initiator._threads.append(sim.spawn(
+        ka_proto.client_probe(ka_sess, None, initiator.keepalive_interval,
+                              on_rtt=tracker.observe_rtt),
+        label=f"{peer_id}.ka-client"))
+
+    if initiator.mempool is not None and version >= n2n.NODE_TO_NODE_V2:
+        tx_out = Session(
+            tx_proto.SPEC, CLIENT,
+            CodecChannel(mux_i.channel(TXSUBMISSION_NUM, INITIATOR),
+                         tx_proto.CODEC))
+        initiator._threads.append(sim.spawn(
+            tx_outbound_loop(tx_out, initiator.mempool),
+            label=f"{peer_id}.tx-out"))
+
+
+async def _run_responder(responder: NodeKernel, mux_r, peer_id) -> None:
+    versions = n2n.node_to_node_versions(responder.network_magic)
+    hs = Session(
+        hs_proto.SPEC, SERVER,
+        CodecChannel(mux_r.channel(n2n.HANDSHAKE_NUM, RESPONDER),
+                     hs_proto.CODEC))
+    res = await hs_proto.server_accept(hs, versions,
+                                       policy=n2n.accept_same_magic)
+    if res[0] != "accepted":
+        sim.trace_event(("handshake-refused", responder.label, peer_id,
+                         res[1]))
+        return
+    version = res[1]
+
+    hdr_dec = responder.header_decode
+    blk_dec = responder.block_decode_obj
+    cs_codec = cs_proto.make_codec(hdr_dec) if hdr_dec else cs_proto.CODEC
+    bf_codec = bf_proto.make_codec(blk_dec) if blk_dec else bf_proto.CODEC
+
     cs_srv = Session(
         cs_proto.SPEC, SERVER,
         CodecChannel(mux_r.channel(CHAINSYNC_NUM, RESPONDER), cs_codec))
@@ -254,16 +354,15 @@ def _connect_directional(initiator: NodeKernel, responder: NodeKernel,
         block_fetch_server(responder.chain_db)(bf_srv),
         label=f"{peer_id}.bf-server"))
 
-    # tx submission: initiator offers its mempool; responder collects
-    if initiator.mempool is not None and responder.mempool is not None \
-            and responder.tx_decode is not None:
-        tx_out = Session(
-            tx_proto.SPEC, CLIENT,
-            CodecChannel(mux_i.channel(TXSUBMISSION_NUM, INITIATOR),
-                         tx_proto.CODEC))
-        initiator._threads.append(sim.spawn(
-            tx_outbound_loop(tx_out, initiator.mempool),
-            label=f"{peer_id}.tx-out"))
+    ka_srv = Session(
+        ka_proto.SPEC, SERVER,
+        CodecChannel(mux_r.channel(KEEPALIVE_NUM, RESPONDER),
+                     ka_proto.CODEC))
+    responder._threads.append(sim.spawn(
+        ka_proto.server(ka_srv), label=f"{peer_id}.ka-server"))
+
+    if responder.mempool is not None and responder.tx_decode is not None \
+            and version >= n2n.NODE_TO_NODE_V2:
         tx_in = Session(
             tx_proto.SPEC, SERVER,
             CodecChannel(mux_r.channel(TXSUBMISSION_NUM, RESPONDER),

@@ -79,6 +79,8 @@ class ThreadNetConfig:
     coin_per_node: int = 1000
     # txs submitted at (slot, node, tx_factory(keys, ledger_state)) hooks
     tx_plan: tuple = ()
+    # per-node handshake network magic (default: all 0 — one network)
+    network_magics: Optional[Sequence[int]] = None
 
 
 @dataclass
@@ -193,12 +195,15 @@ def run_threadnet(cfg: ThreadNetConfig) -> ThreadNetResult:
             forge=lambda protocol, proof, hdr, hk=hot_key:
                 praos_forge_fields(protocol, hk, proof, hdr))
         btime = BlockchainTime(cfg.slot_length)
-        return NodeKernel(db, ledger, mempool, btime, [forging],
+        kern = NodeKernel(db, ledger, mempool, btime, [forging],
                           label=f"node{i}", backend=backend,
                           chain_sync_window=cfg.chain_sync_window,
                           header_decode=header_decode_obj,
                           block_decode_obj=block_decode_obj,
                           tx_decode=Tx.decode)
+        if cfg.network_magics is not None:
+            kern.network_magic = cfg.network_magics[i]
+        return kern
 
     def edges() -> list[tuple[int, int]]:
         n = cfg.n_nodes
