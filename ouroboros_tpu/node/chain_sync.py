@@ -164,12 +164,15 @@ def _block_no_at(db, point: Point) -> int:
     raise ChainSyncClientError(f"intersection {point} not on our chain")
 
 
-async def chain_sync_server(session, chain_db) -> None:
+async def chain_sync_server(session, chain_db, content_of=None) -> None:
     """ChainSync server from a ChainDB follower (ChainSync/Server.hs).
 
-    Serves headers of the current chain; blocks on the ChainDB version TVar
-    when the follower is caught up (followerInstructionBlocking).
+    Serves the current chain — headers by default; pass
+    ``content_of=lambda b: b`` for the node-to-client variant that rolls
+    full blocks forward.  Blocks on the ChainDB version TVar when the
+    follower is caught up (followerInstructionBlocking).
     """
+    content_of = content_of or (lambda b: b.header)
     from ..network.protocols.chainsync import (
         MsgDone, MsgIntersectFound, MsgIntersectNotFound, MsgRequestNext,
     )
@@ -210,7 +213,7 @@ async def chain_sync_server(session, chain_db) -> None:
             kind, payload = ins
             tip = _tip_of(chain_db)
             if kind == "forward":
-                await session.send(MsgRollForward(payload.header, tip))
+                await session.send(MsgRollForward(content_of(payload), tip))
             else:
                 await session.send(MsgRollBackward(payload, tip))
     finally:

@@ -5,8 +5,9 @@ and benchmarks share one harness (reference: ouroboros-consensus-test/src/
 Test/ThreadNet/{General,Network}.hs).
 """
 from .threadnet import (
-    ThreadNetConfig, ThreadNetResult, praos_node_keys, run_threadnet,
+    PraosNetworkFactory, ThreadNetConfig, ThreadNetResult, praos_node_keys,
+    run_threadnet,
 )
 
-__all__ = ["ThreadNetConfig", "ThreadNetResult", "praos_node_keys",
-           "run_threadnet"]
+__all__ = ["PraosNetworkFactory", "ThreadNetConfig", "ThreadNetResult",
+           "praos_node_keys", "run_threadnet"]

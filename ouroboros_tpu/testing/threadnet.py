@@ -137,27 +137,39 @@ class ThreadNetResult:
         return max(c.head_block_no + 1 for c in self.chains)
 
 
-def run_threadnet(cfg: ThreadNetConfig) -> ThreadNetResult:
-    """Run the network to n_slots and collect final chains (runTestNetwork)."""
-    keys = [praos_node_keys(i, cfg.kes_depth) for i in range(cfg.n_nodes)]
-    protocol_cfg = PraosConfig(
-        nodes=tuple(PraosNode(k.vrf_vk, k.kes_vk, stake=1) for k in keys),
-        k=cfg.k, f=cfg.f, epoch_length=cfg.epoch_length,
-        kes_depth=cfg.kes_depth,
-        slots_per_kes_period=cfg.slots_per_kes_period)
-    genesis = {k.payment_vk: cfg.coin_per_node for k in keys}
-    backend = OpensslBackend()
+class PraosNetworkFactory:
+    """Builds the per-node stacks for a mock-Praos network; reused by
+    run_threadnet and by node-to-client / tooling tests that need one
+    full node outside the ThreadNet driver."""
 
+    def __init__(self, cfg: ThreadNetConfig):
+        self.cfg = cfg
+        self.keys = [praos_node_keys(i, cfg.kes_depth)
+                     for i in range(cfg.n_nodes)]
+        self.protocol_cfg = PraosConfig(
+            nodes=tuple(PraosNode(k.vrf_vk, k.kes_vk, stake=1)
+                        for k in self.keys),
+            k=cfg.k, f=cfg.f, epoch_length=cfg.epoch_length,
+            kes_depth=cfg.kes_depth,
+            slots_per_kes_period=cfg.slots_per_kes_period)
+        self.genesis = {k.payment_vk: cfg.coin_per_node for k in self.keys}
+        self.backend = OpensslBackend()
+
+    # -- codecs ---------------------------------------------------------------
+    @staticmethod
     def block_decode(raw: bytes) -> ProtocolBlock:
         return ProtocolBlock.decode(cbor.loads(raw), tx_decode=Tx.decode)
 
+    @staticmethod
     def header_decode_obj(obj):
         from ..consensus.headers import ProtocolHeader
         return ProtocolHeader.decode(obj)
 
+    @staticmethod
     def block_decode_obj(obj):
         return ProtocolBlock.decode(obj, tx_decode=Tx.decode)
 
+    @staticmethod
     def enc_state(ext: ExtLedgerState):
         dep: PraosState = ext.header.chain_dep_state
         tip = ext.header.tip
@@ -166,6 +178,7 @@ def run_threadnet(cfg: ThreadNetConfig) -> ThreadNetResult:
                 None if tip is None else [tip.slot, tip.block_no, tip.hash],
                 [dep.epoch, dep.eta, list(dep.pending)]]
 
+    @staticmethod
     def dec_state(obj) -> ExtLedgerState:
         utxo = tuple((bytes(e[0]), int(e[1]), bytes(e[2]), int(e[3]))
                      for e in obj[0])
@@ -176,34 +189,42 @@ def run_threadnet(cfg: ThreadNetConfig) -> ThreadNetResult:
                          tuple(bytes(p) for p in obj[4][2]))
         return ExtLedgerState(led, HeaderState(tip, dep))
 
-    kernels: list[NodeKernel] = []
-
-    def make_node(i: int) -> NodeKernel:
-        protocol = Praos(protocol_cfg)
-        ledger = MockLedger(genesis)
+    def make_node(self, i: int) -> NodeKernel:
+        cfg, keys = self.cfg, self.keys
+        protocol = Praos(self.protocol_cfg)
+        ledger = MockLedger(self.genesis)
         ext_rules = ExtLedgerRules(protocol, ledger)
         fs = MockFS()
-        db = ChainDB.open(fs, ext_rules, enc_state, dec_state, block_decode,
-                          backend=backend)
+        db = ChainDB.open(fs, ext_rules, self.enc_state, self.dec_state,
+                          self.block_decode, backend=self.backend)
         mempool = Mempool(ledger,
                           lambda db=db: (db.current_ledger.ledger,
                                          db.tip_point()),
-                          backend=backend)
-        hot_key = HotKey(kes_mod.KesSignKey(cfg.kes_depth, keys[i].kes_seed))
+                          backend=self.backend)
+        hot_key = HotKey(kes_mod.KesSignKey(cfg.kes_depth,
+                                            keys[i].kes_seed))
         forging = BlockForging(
             issuer=i, can_be_leader=(i, keys[i].vrf_sk),
             forge=lambda protocol, proof, hdr, hk=hot_key:
                 praos_forge_fields(protocol, hk, proof, hdr))
         btime = BlockchainTime(cfg.slot_length)
         kern = NodeKernel(db, ledger, mempool, btime, [forging],
-                          label=f"node{i}", backend=backend,
+                          label=f"node{i}", backend=self.backend,
                           chain_sync_window=cfg.chain_sync_window,
-                          header_decode=header_decode_obj,
-                          block_decode_obj=block_decode_obj,
+                          header_decode=self.header_decode_obj,
+                          block_decode_obj=self.block_decode_obj,
                           tx_decode=Tx.decode)
         if cfg.network_magics is not None:
             kern.network_magic = cfg.network_magics[i]
         return kern
+
+
+def run_threadnet(cfg: ThreadNetConfig) -> ThreadNetResult:
+    """Run the network to n_slots and collect final chains (runTestNetwork)."""
+    factory = PraosNetworkFactory(cfg)
+    keys = factory.keys
+    kernels: list[NodeKernel] = []
+    make_node = factory.make_node
 
     def edges() -> list[tuple[int, int]]:
         n = cfg.n_nodes
