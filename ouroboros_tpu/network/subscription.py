@@ -1,0 +1,112 @@
+"""Subscription workers — valency-tracked outbound connection maintenance.
+
+Reference: ouroboros-network-framework/src/Ouroboros/Network/Subscription/
+Worker.hs:207-233 (`worker`/`subscriptionLoop`: keep `valency` live
+connections from a target list, redialling as they fail), Ip.hs:66-89 (IP
+targets), PeerState.hs (per-peer suspension state consulted before
+dialling), with ErrorPolicy verdicts driving the suspensions.
+
+The dial function abstracts the transport (in-sim kernel dialling here;
+a socket Snocket plugs into the same seam).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Sequence
+
+from .. import simharness as sim
+from .error_policy import ErrorPolicy, eval_error_policies
+
+
+@dataclass
+class PeerState:
+    """Subscription/PeerState.hs: per-address dial bookkeeping."""
+    fail_count: int = 0
+    suspended_until: float = 0.0
+    connected: bool = False
+
+
+class SubscriptionWorker:
+    """Maintain `valency` live connections from `targets`.
+
+    dial(addr) -> Async handle whose completion (normal or exceptional)
+    means the connection ended.  Failures are classified by the error
+    policies into suspension windows before the address is redialled.
+    """
+
+    def __init__(self, targets: Sequence, valency: int,
+                 dial: Callable, error_policies: Sequence[ErrorPolicy] = (),
+                 base_backoff: float = 5.0, label: str = "subscription"):
+        self.targets = list(targets)
+        self.valency = min(valency, len(self.targets))
+        self.dial = dial
+        self.error_policies = list(error_policies)
+        self.base_backoff = base_backoff
+        self.label = label
+        self.states: Dict[object, PeerState] = {
+            a: PeerState() for a in self.targets}
+        self.trace: list = []
+        self._conns: Dict[object, object] = {}     # addr -> Async
+
+    def _candidates(self) -> list:
+        now = sim.now()
+        return [a for a in self.targets
+                if not self.states[a].connected
+                and self.states[a].suspended_until <= now]
+
+    def _on_conn_end(self, addr, exc: Optional[BaseException]) -> None:
+        st = self.states[addr]
+        st.connected = False
+        if exc is not None:
+            verdict = eval_error_policies(self.error_policies, exc)
+            dur = verdict.duration if verdict is not None \
+                else self.base_backoff
+        else:
+            dur = self.base_backoff
+        st.fail_count += 1
+        st.suspended_until = sim.now() + dur * (2 ** min(st.fail_count, 5))
+        self.trace.append((sim.now(), "conn-end", addr, repr(exc)))
+
+    async def run(self) -> None:
+        """subscriptionLoop: top up to valency, then block until a
+        connection ends (watcher threads feed an STM queue) or a
+        suspension window expires."""
+        from ..simharness import TQueue
+        endings: TQueue = TQueue(label=f"{self.label}-endings")
+
+        async def watch(addr, handle):
+            exc = None
+            try:
+                await handle.wait()
+            except BaseException as e:
+                exc = e
+
+            def push(tx):
+                endings.put(tx, (addr, exc))
+            await sim.atomically(push)
+
+        while True:
+            for addr in self._candidates():
+                if len(self._conns) >= self.valency:
+                    break
+                st = self.states[addr]
+                st.connected = True
+                self.trace.append((sim.now(), "dial", addr))
+                handle = self.dial(addr)
+                self._conns[addr] = handle
+                sim.spawn(watch(addr, handle),
+                          label=f"{self.label}.watch-{addr}")
+
+            # wait for an ending, or poll again when the earliest
+            # suspension expires
+            now = sim.now()
+            pending = [s.suspended_until for s in self.states.values()
+                       if not s.connected and s.suspended_until > now]
+            wait_for = min(pending) - now if pending else self.base_backoff
+            done, item = await sim.timeout(
+                max(wait_for, 0.01),
+                sim.atomically(lambda tx: endings.get(tx)))
+            if done and item is not None:
+                addr, exc = item
+                self._conns.pop(addr, None)
+                self._on_conn_end(addr, exc)

@@ -1,0 +1,78 @@
+"""Diffusion — compose the node's whole network surface.
+
+Reference: ouroboros-network/src/Ouroboros/Network/Diffusion.hs:119-245
+(`runDataDiffusion` composes: IOManager, snockets, local server for
+wallets, IP/DNS subscription workers for outbound, accept servers for
+inbound, error policies) — here over the in-sim address registry (the
+Snocket seam: a socket transport plugs into `SimNetwork.dial` the same
+way).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence
+
+from .. import simharness as sim
+from ..network.error_policy import default_node_policies
+from ..network.subscription import SubscriptionWorker
+from .kernel import NodeKernel, _connect_directional
+
+
+class SimNetwork:
+    """Address registry standing in for the Snocket layer: maps addresses
+    to listening kernels and dials by spawning directional connections."""
+
+    def __init__(self, link_delay: float = 0.05, sdu_size: int = 12288):
+        self.link_delay = link_delay
+        self.sdu_size = sdu_size
+        self.listeners: Dict[object, NodeKernel] = {}
+
+    def listen(self, addr, kernel: NodeKernel) -> None:
+        self.listeners[addr] = kernel
+
+    def make_dial(self, kernel: NodeKernel):
+        def dial(addr):
+            target = self.listeners.get(addr)
+            if target is None:
+                async def fail():
+                    raise ConnectionError(f"no listener at {addr}")
+                return sim.spawn(fail(), label=f"dial-fail-{addr}")
+            return _connect_directional(kernel, target,
+                                        self.link_delay, self.sdu_size)
+        return dial
+
+
+@dataclass
+class DiffusionArguments:
+    """Diffusion.hs:119 `DiffusionArguments` analog."""
+    address: object                          # our listening address
+    ip_targets: Sequence = ()                # peers to maintain
+    valency: int = 2
+    error_policies: Optional[list] = None
+
+
+@dataclass
+class Diffusion:
+    worker: Optional[SubscriptionWorker]
+    threads: list = field(default_factory=list)
+
+
+def run_data_diffusion(kernel: NodeKernel, network: SimNetwork,
+                       args: DiffusionArguments) -> Diffusion:
+    """Register the accept side, start outbound subscription maintenance
+    (runDataDiffusion's composition, minus OS specifics)."""
+    network.listen(args.address, kernel)
+    worker = None
+    if args.ip_targets:
+        worker = SubscriptionWorker(
+            targets=list(args.ip_targets),
+            valency=args.valency,
+            dial=network.make_dial(kernel),
+            error_policies=(args.error_policies
+                            if args.error_policies is not None
+                            else default_node_policies()),
+            label=f"{kernel.label}-subscription")
+        t = sim.spawn(worker.run(), label=f"{kernel.label}-subscription")
+        kernel._threads.append(t)
+        return Diffusion(worker, [t])
+    return Diffusion(worker)

@@ -236,7 +236,7 @@ def connect_nodes(a: NodeKernel, b: NodeKernel, delay: float = 0.0,
 
 
 def _connect_directional(initiator: NodeKernel, responder: NodeKernel,
-                         delay: float, sdu_size: int) -> None:
+                         delay: float, sdu_size: int):
     """initiator runs chainsync/blockfetch clients against responder's
     servers (learning responder's chain) and offers its txs to responder's
     inbound (NodeToNode.hs initiator/responder application split).
@@ -251,15 +251,20 @@ def _connect_directional(initiator: NodeKernel, responder: NodeKernel,
     mux_i.start()
     mux_r.start()
 
-    initiator._threads.append(sim.spawn(
-        _run_initiator(initiator, mux_i, peer_id),
-        label=f"{peer_id}.connect-i"))
+    handle = sim.spawn(_run_initiator(initiator, mux_i, peer_id),
+                       label=f"{peer_id}.connect-i")
+    initiator._threads.append(handle)
     responder._threads.append(sim.spawn(
         _run_responder(responder, mux_r, peer_id),
         label=f"{peer_id}.connect-r"))
+    return handle
 
 
 async def _run_initiator(initiator: NodeKernel, mux_i, peer_id) -> None:
+    """The initiator-side connection runner.  Completes when the ChainSync
+    client ends (the connection's liveness signal — Client.hs kill
+    semantics); satellite protocols are cancelled on exit so subscription
+    workers can treat completion as connection-down and redial."""
     versions = n2n.node_to_node_versions(initiator.network_magic)
     hs = Session(
         hs_proto.SPEC, CLIENT,
@@ -285,18 +290,11 @@ async def _run_initiator(initiator: NodeKernel, mux_i, peer_id) -> None:
     candidate = initiator.new_candidate(peer_id)
     initiator.peer_fetch[peer_id] = PeerFetchState(peer_id)
 
-    cs_sess = PipelinedSession(
-        cs_proto.SPEC, CLIENT,
-        CodecChannel(mux_i.channel(CHAINSYNC_NUM, INITIATOR), cs_codec),
-        max_outstanding=initiator.chain_sync_window + 2)
-    initiator._threads.append(sim.spawn(
-        _supervise_chain_sync(initiator, cs_sess, candidate, peer_id),
-        label=f"{peer_id}.cs-client"))
-
+    satellites = []
     bf_sess = Session(
         bf_proto.SPEC, CLIENT,
         CodecChannel(mux_i.channel(BLOCKFETCH_NUM, INITIATOR), bf_codec))
-    initiator._threads.append(sim.spawn(
+    satellites.append(sim.spawn(
         block_fetch_client(bf_sess, initiator, peer_id),
         label=f"{peer_id}.bf-client"))
 
@@ -306,7 +304,7 @@ async def _run_initiator(initiator: NodeKernel, mux_i, peer_id) -> None:
         ka_proto.SPEC, CLIENT,
         CodecChannel(mux_i.channel(KEEPALIVE_NUM, INITIATOR),
                      ka_proto.CODEC))
-    initiator._threads.append(sim.spawn(
+    satellites.append(sim.spawn(
         ka_proto.client_probe(ka_sess, None, initiator.keepalive_interval,
                               on_rtt=tracker.observe_rtt),
         label=f"{peer_id}.ka-client"))
@@ -316,9 +314,21 @@ async def _run_initiator(initiator: NodeKernel, mux_i, peer_id) -> None:
             tx_proto.SPEC, CLIENT,
             CodecChannel(mux_i.channel(TXSUBMISSION_NUM, INITIATOR),
                          tx_proto.CODEC))
-        initiator._threads.append(sim.spawn(
+        satellites.append(sim.spawn(
             tx_outbound_loop(tx_out, initiator.mempool),
             label=f"{peer_id}.tx-out"))
+    initiator._threads.extend(satellites)
+
+    cs_sess = PipelinedSession(
+        cs_proto.SPEC, CLIENT,
+        CodecChannel(mux_i.channel(CHAINSYNC_NUM, INITIATOR), cs_codec),
+        max_outstanding=initiator.chain_sync_window + 2)
+    try:
+        await _supervise_chain_sync(initiator, cs_sess, candidate, peer_id)
+    finally:
+        for s in satellites:
+            s.cancel()
+        initiator.drop_peer(peer_id)
 
 
 async def _run_responder(responder: NodeKernel, mux_r, peer_id) -> None:
