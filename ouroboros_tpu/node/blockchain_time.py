@@ -47,7 +47,11 @@ class BlockchainTime:
             delay = at - sim.now()
             if delay > 0:
                 await sim.sleep(delay)
-            self.current.set_notify(int(sim.now() / self.slot_length))
+            # max() guards against float truncation (int(k*L/L) can be
+            # k-1): the slot always advances, so this loop cannot spin
+            # without yielding, and the TVar is monotone
+            self.current.set_notify(
+                max(nxt, int(sim.now() / self.slot_length)))
 
     async def wait_slot_after(self, prev: int) -> int:
         """Block until the current slot is > prev; return it."""
@@ -57,3 +61,40 @@ class BlockchainTime:
                 raise Retry()
             return s
         return await sim.atomically(tx_fn)
+
+
+class HardForkBlockchainTime(BlockchainTime):
+    """Slot ticking through the era summary — slot length may change at
+    era boundaries (BlockchainTime/WallClock/HardFork.hs:
+    hardForkBlockchainTime interprets the HFC time summary).
+
+    get_summary() is re-read every tick so a transition decided by the
+    ledger mid-run takes effect (the reference re-runs the Qry against the
+    current ledger state the same way).
+    """
+
+    def __init__(self, get_summary):
+        self.get_summary = get_summary
+        try:
+            now = sim.now()
+        except RuntimeError:             # outside the sim: epoch start
+            now = 0.0
+        self.current = TVar(get_summary().wallclock_to_slot(now),
+                            label="current-slot")
+        self._ticker = None
+
+    async def _tick_loop(self) -> None:
+        while True:
+            summary = self.get_summary()
+            nxt = self.current.value + 1
+            at = summary.slot_to_wallclock(nxt)
+            delay = at - sim.now()
+            if delay > 0:
+                await sim.sleep(delay)
+            # max(nxt, ...) keeps the slot monotone and always advancing:
+            # float truncation can compute nxt-1, and a transition decided
+            # during the sleep can remap the wallclock to an earlier slot
+            # — neither may regress the TVar or stall this loop
+            self.current.set_notify(
+                max(nxt,
+                    self.get_summary().wallclock_to_slot(sim.now())))

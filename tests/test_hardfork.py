@@ -1,0 +1,243 @@
+"""HardFork combinator: time translation, era crossing, era-tag
+enforcement, batched validation across the boundary.
+
+Reference test surface: HardFork History property tests (slot/epoch/time
+roundtrips), Combinator era transition (the ThreadNet cross-era suites
+Cardano/ShelleyAllegra — SURVEY.md §4.1).
+"""
+import hashlib
+
+import pytest
+
+from ouroboros_tpu.chain.block import Point
+from ouroboros_tpu.consensus import ExtLedgerRules
+from ouroboros_tpu.consensus.batch import validate_blocks_batched
+from ouroboros_tpu.consensus.hardfork import (
+    Bound, Era, EraParams, HardForkLedger, HardForkProtocol, HardForkState,
+    PastHorizon, Summary, hard_fork_rules,
+)
+from ouroboros_tpu.consensus.hardfork.combinator import ERA_FIELD, hfc_forge
+from ouroboros_tpu.consensus.headers import ProtocolBlock, make_header
+from ouroboros_tpu.consensus.ledger import LedgerError
+from ouroboros_tpu.consensus.protocol import ProtocolError
+from ouroboros_tpu.consensus.protocols import Bft, bft_sign_header
+from ouroboros_tpu.consensus.protocols.praos import (
+    HotKey, Praos, PraosConfig, PraosNode, praos_forge_fields,
+)
+from ouroboros_tpu.crypto import ed25519_ref, kes as kes_mod
+from ouroboros_tpu.crypto.backend import OpensslBackend
+from ouroboros_tpu.ledgers import MockLedger
+
+BACKEND = OpensslBackend()
+
+
+class TestHistory:
+    def _summary(self):
+        # era 0: 10-slot epochs, 1s slots, ends at epoch 2 (slot 20)
+        # era 1: 5-slot epochs, 0.5s slots, open
+        return Summary.from_era_params(
+            [EraParams(10, 1.0), EraParams(5, 0.5)], [2])
+
+    def test_boundary_alignment(self):
+        s = self._summary()
+        e0, e1 = s.eras
+        assert e0.end == Bound(20.0, 20, 2)
+        assert e1.start == e0.end and e1.end is None
+
+    def test_slot_epoch_roundtrip_across_eras(self):
+        s = self._summary()
+        assert s.slot_to_epoch(0) == (0, 0)
+        assert s.slot_to_epoch(19) == (1, 9)
+        assert s.slot_to_epoch(20) == (2, 0)       # first slot of era 1
+        assert s.slot_to_epoch(27) == (3, 2)       # 5-slot epochs now
+        for slot in (0, 7, 19, 20, 24, 25, 99):
+            ep, off = s.slot_to_epoch(slot)
+            assert s.epoch_to_first_slot(ep) + off == slot
+
+    def test_wallclock_translation(self):
+        s = self._summary()
+        assert s.slot_to_wallclock(19) == 19.0
+        assert s.slot_to_wallclock(20) == 20.0
+        assert s.slot_to_wallclock(22) == 21.0     # 0.5s slots
+        for t in (0.0, 5.5, 19.9, 20.0, 23.75):
+            slot = s.wallclock_to_slot(t)
+            assert s.slot_to_wallclock(slot) <= t
+        assert s.slot_length_at(5) == 1.0 and s.slot_length_at(25) == 0.5
+
+    def test_past_horizon_on_closed_summary(self):
+        closed = Summary.from_era_params(
+            [EraParams(10, 1.0), EraParams(5, 0.5)], [1])
+        # make era 1 closed too, by hand
+        e1 = closed.eras[1]
+        closed.eras[1] = type(e1)(e1.start, e1.next_bound(4), e1.params)
+        last = closed.eras[1].end.slot
+        with pytest.raises(PastHorizon):
+            closed.slot_to_epoch(last)
+
+
+def _keys(n, tag=b"hfc"):
+    sks = [hashlib.sha256(tag + bytes([i])).digest() for i in range(n)]
+    return sks, [ed25519_ref.public_key(sk) for sk in sks]
+
+
+def _two_eras(transition_epoch=2, epoch_size=10, n_nodes=2,
+              kes_depth=5):
+    """Era 0: BFT.  Era 1: mock Praos.  Same mock UTxO ledger both sides
+    (identity translation), transition at a fixed epoch — the
+    Byron→Shelley shape."""
+    sks, vks = _keys(n_nodes)
+    vrf_sks, vrf_vks = _keys(n_nodes, b"vrf")
+    kes_seeds = [hashlib.sha256(b"kes" + bytes([i])).digest()
+                 for i in range(n_nodes)]
+    kes_vks = [kes_mod.vk_of(kes_depth, s) for s in kes_seeds]
+    genesis = {vk: 100 for vk in vks}
+
+    bft = Bft(vks, k=5)
+    praos = Praos(PraosConfig(
+        nodes=tuple(PraosNode(vrf_vks[i], kes_vks[i], 1)
+                    for i in range(n_nodes)),
+        k=5, f=0.9, epoch_length=epoch_size, kes_depth=kes_depth,
+        slots_per_kes_period=epoch_size))
+    from ouroboros_tpu.consensus.protocols.praos import PraosState
+    eras = [
+        Era("bft", bft, MockLedger(genesis), EraParams(epoch_size, 1.0),
+            transition_epoch=lambda st, e=transition_epoch: e,
+            # the Byron→Shelley-style protocol-state translation: the new
+            # era's chain-dep state is built fresh at the boundary
+            translate_chain_dep=lambda s: PraosState.genesis()),
+        Era("praos", praos, MockLedger(genesis),
+            EraParams(epoch_size, 1.0)),
+    ]
+    keys = dict(sks=sks, vks=vks, vrf_sks=vrf_sks, vrf_vks=vrf_vks,
+                kes_seeds=kes_seeds, kes_vks=kes_vks,
+                kes_depth=kes_depth)
+    return eras, keys
+
+
+def _forge_chain(eras, keys, n_blocks, transition_slot):
+    """Forge a valid chain crossing the era boundary using the combinator
+    protocol's own leadership checks."""
+    rules = hard_fork_rules(eras)
+    protocol, ledger = rules.protocol, rules.ledger
+    hot_keys = [HotKey(kes_mod.KesSignKey(keys["kes_depth"], s))
+                for s in keys["kes_seeds"]]
+
+    def forges_for(i):
+        return hfc_forge(eras, {
+            0: lambda p, proof, hdr, i=i: bft_sign_header(keys["sks"][i],
+                                                          hdr),
+            1: lambda p, proof, hdr, i=i: praos_forge_fields(
+                p, hot_keys[i], proof, hdr),
+        })
+
+    ext = rules.initial_state()
+    blocks = []
+    prev = None
+    slot = 0
+    while len(blocks) < n_blocks:
+        view = ledger.ledger_view(ext.ledger)
+        ticked_dep = protocol.tick_chain_dep_state(
+            ext.header.chain_dep_state, view, slot)
+        proof = None
+        issuer = None
+        for i in range(len(keys["sks"])):
+            cbl = {0: i, 1: (i, keys["vrf_sks"][i])}
+            proof = protocol.check_is_leader(cbl, slot, ticked_dep, view)
+            if proof is not None:
+                issuer = i
+                break
+        if proof is None:
+            slot += 1
+            continue
+        hdr = make_header(prev, slot, (), issuer=issuer)
+        signed = forges_for(issuer)(protocol, proof, hdr)
+        blk = ProtocolBlock(signed, ())
+        ext = rules.tick_then_apply(ext, blk, backend=BACKEND)
+        blocks.append(blk)
+        prev = signed
+        slot += 1
+    return rules, blocks, ext
+
+
+def test_degenerate_single_era():
+    """One-era combinator behaves like the inner stack (Degenerate.hs)."""
+    eras, keys = _two_eras()
+    rules = hard_fork_rules(eras[:1])
+    ext = rules.initial_state()
+    hdr = make_header(None, 0, (), issuer=0)
+    hdr = hdr.with_fields(**{ERA_FIELD: 0})
+    signed = bft_sign_header(keys["sks"][0], hdr)
+    blk = ProtocolBlock(signed, ())
+    ext2 = rules.tick_then_apply(ext, blk, backend=BACKEND)
+    assert ext2.header.chain_dep_state.era == 0
+
+
+def test_chain_crosses_era_boundary():
+    eras, keys = _two_eras(transition_epoch=2, epoch_size=10)
+    rules, blocks, ext = _forge_chain(eras, keys, n_blocks=30,
+                                      transition_slot=20)
+    tags = [b.header.get(ERA_FIELD) for b in blocks]
+    assert 0 in tags and 1 in tags, "chain never crossed the boundary"
+    switch = tags.index(1)
+    assert blocks[switch].slot >= 20
+    assert blocks[switch - 1].slot < 20
+    assert all(t == 0 for t in tags[:switch])
+    assert all(t == 1 for t in tags[switch:])
+    # final state is in era 1 with the recorded transition
+    assert ext.ledger.era == 1 and ext.ledger.transitions == (2,)
+    assert ext.header.chain_dep_state.era == 1
+
+
+def test_wrong_era_tag_rejected():
+    eras, keys = _two_eras()
+    rules = hard_fork_rules(eras)
+    ext = rules.initial_state()
+    hdr = make_header(None, 0, (), issuer=0)
+    hdr = hdr.with_fields(**{ERA_FIELD: 1})      # lies about its era
+    signed = bft_sign_header(keys["sks"][0], hdr)
+    with pytest.raises((LedgerError, Exception)):
+        rules.tick_then_apply(ext, ProtocolBlock(signed, ()),
+                              backend=BACKEND)
+
+
+def test_missing_era_tag_rejected():
+    eras, keys = _two_eras()
+    rules = hard_fork_rules(eras)
+    ext = rules.initial_state()
+    hdr = make_header(None, 0, (), issuer=0)
+    signed = bft_sign_header(keys["sks"][0], hdr)
+    with pytest.raises(Exception):
+        rules.tick_then_apply(ext, ProtocolBlock(signed, ()),
+                              backend=BACKEND)
+
+
+def test_batched_validation_across_boundary():
+    """validate_blocks_batched (the TPU window driver) handles a window
+    spanning the era boundary — proofs from BOTH eras in one batch."""
+    eras, keys = _two_eras(transition_epoch=1, epoch_size=5)
+    rules, blocks, ext_seq = _forge_chain(eras, keys, n_blocks=12,
+                                          transition_slot=5)
+    res = validate_blocks_batched(rules, blocks, rules.initial_state(),
+                                  backend=BACKEND)
+    assert res.all_valid, res.error
+    assert res.n_valid == len(blocks)
+    # batched fold reaches the same final state as the sequential fold
+    assert res.final_state.ledger == ext_seq.ledger
+    assert res.final_state.header.chain_dep_state == \
+        ext_seq.header.chain_dep_state
+
+
+def test_translation_hook_applied():
+    """A non-identity ledger translation runs at the boundary."""
+    eras, keys = _two_eras(transition_epoch=1, epoch_size=5)
+    marker = {}
+
+    def translating(state):
+        marker["ran"] = True
+        return state
+    import dataclasses
+    eras[0] = dataclasses.replace(eras[0], translate_ledger=translating)
+    rules, blocks, ext = _forge_chain(eras, keys, n_blocks=8,
+                                      transition_slot=5)
+    assert marker.get("ran"), "translate_ledger never invoked"
+    assert ext.ledger.era == 1
