@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""db-analyser — open an on-disk chain DB, replay it, report.
+
+Reference: ouroboros-consensus-cardano/tools/db-analyser/ —
+Main.hs:27-40,95-145 (CLI: db dir, block-type config, --onlyImmutableDB,
+analysis selection), Analysis.hs (ShowSlotBlockNo / CountTxOutputs /
+ShowBlockHeaderSize / OnlyValidation streaming every block through an
+iterator), and the validate-mainnet CI gate (§3.5) that replays the whole
+chain through the ledger.
+
+TPU twist: `--validate full` replays through consensus/batch.py — the
+VRF+KES+Ed25519 proofs of a `--window` of blocks verified as ONE device
+batch per window — with `--backend {ref,openssl,jax}` selecting the
+CryptoBackend.  This is the BASELINE.md harness: blocks/sec + proofs/sec
+per backend, plus the final ledger state hash for replay-parity checks.
+
+Usage:
+  python tools/db_analyser.py DIR --analysis show-slot-block-no
+  python tools/db_analyser.py DIR --analysis count-tx-outputs
+  python tools/db_analyser.py DIR --analysis show-header-size
+  python tools/db_analyser.py DIR --analysis validate \\
+      [--validate reapply|full] [--backend ref|openssl|jax] [--window 256]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_db(db_dir: str):
+    from ouroboros_tpu.consensus.headers import ProtocolBlock
+    from ouroboros_tpu.consensus.ledger import ExtLedgerRules
+    from ouroboros_tpu.consensus.protocols.praos import (
+        Praos, PraosConfig, PraosNode,
+    )
+    from ouroboros_tpu.ledgers.mock import MockLedger, Tx
+    from ouroboros_tpu.storage.fs import IoFS
+    from ouroboros_tpu.storage.immutabledb import ImmutableDB
+    from ouroboros_tpu.utils import cbor
+
+    with open(os.path.join(db_dir, "config.json")) as fh:
+        cfg = json.load(fh)
+    assert cfg["protocol"] == "mock-praos", cfg["protocol"]
+    protocol = Praos(PraosConfig(
+        nodes=tuple(PraosNode(bytes.fromhex(nd["vrf_vk"]),
+                              bytes.fromhex(nd["kes_vk"]), nd["stake"])
+                    for nd in cfg["nodes"]),
+        k=cfg["k"], f=cfg["f"], epoch_length=cfg["epoch_length"],
+        kes_depth=cfg["kes_depth"],
+        slots_per_kes_period=cfg["slots_per_kes_period"]))
+    ledger = MockLedger({bytes.fromhex(vk): amt
+                         for vk, amt in cfg["genesis"].items()})
+    rules = ExtLedgerRules(protocol, ledger)
+    fs = IoFS(db_dir)
+    db = ImmutableDB.open(fs, cfg.get("chunk_size", 100),
+                          validate_all=False)
+
+    def decode(raw: bytes) -> ProtocolBlock:
+        return ProtocolBlock.decode(cbor.loads(raw), tx_decode=Tx.decode)
+
+    return db, rules, decode
+
+
+def make_backend(name: str):
+    from ouroboros_tpu.crypto.backend import CpuRefBackend, OpensslBackend
+    if name == "ref":
+        return CpuRefBackend()
+    if name == "openssl":
+        return OpensslBackend()
+    if name == "jax":
+        from ouroboros_tpu.crypto.jax_backend import JaxBackend
+        return JaxBackend()
+    raise SystemExit(f"unknown backend {name}")
+
+
+def analysis_show_slot_block_no(db, decode, out):
+    for entry, raw in db.stream():
+        b = decode(raw)
+        out.write(f"{b.slot}\t{b.block_no}\t{b.hash.hex()[:16]}\n")
+
+
+def analysis_count_tx_outputs(db, decode, out):
+    total = blocks = txs = 0
+    for entry, raw in db.stream():
+        b = decode(raw)
+        blocks += 1
+        for tx in b.body:
+            txs += 1
+            total += len(tx.outputs)
+    out.write(json.dumps({"blocks": blocks, "txs": txs,
+                          "tx_outputs": total}) + "\n")
+
+
+def analysis_show_header_size(db, decode, out):
+    biggest = (0, None)
+    for entry, raw in db.stream():
+        b = decode(raw)
+        size = len(b.header.bytes)
+        if size > biggest[0]:
+            biggest = (size, b.slot)
+        out.write(f"{b.slot}\t{size}\n")
+    out.write(f"# max header size {biggest[0]} at slot {biggest[1]}\n")
+
+
+def analysis_validate(db, rules, decode, backend_name: str, mode: str,
+                      window: int, out):
+    from ouroboros_tpu.consensus.batch import validate_blocks_batched
+
+    backend = make_backend(backend_name) if mode == "full" else None
+    ext = rules.initial_state()
+    blocks = proofs = 0
+    t0 = time.time()
+    buf = []
+    for entry, raw in db.stream():
+        b = decode(raw)
+        blocks += 1
+        proofs += 2 + sum(len(tx.witnesses) for tx in b.body)
+        if mode == "reapply":
+            ext = rules.tick_then_reapply(ext, b)
+            continue
+        buf.append(b)
+        if len(buf) >= window:
+            res = validate_blocks_batched(rules, buf, ext, backend=backend)
+            if not res.all_valid:
+                raise SystemExit(
+                    f"validation FAILED at block {blocks - len(buf) + res.n_valid}: "
+                    f"{res.error}")
+            ext = res.final_state
+            buf = []
+    if mode == "full" and buf:
+        res = validate_blocks_batched(rules, buf, ext, backend=backend)
+        if not res.all_valid:
+            raise SystemExit(f"validation FAILED: {res.error}")
+        ext = res.final_state
+    secs = time.time() - t0
+    out.write(json.dumps({
+        "analysis": "validate", "mode": mode,
+        "backend": backend_name if mode == "full" else "n/a",
+        "window": window if mode == "full" else None,
+        "blocks": blocks, "proofs": proofs,
+        "secs": round(secs, 3),
+        "blocks_per_sec": round(blocks / secs, 1),
+        "proofs_per_sec": round(proofs / secs, 1),
+        "state_hash": ext.ledger.state_hash().hex(),
+        "tip_slot": ext.header.tip.slot if ext.header.tip else None,
+    }) + "\n")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("db", help="DB directory (from db_synth or a node)")
+    ap.add_argument("--analysis", default="validate",
+                    choices=["show-slot-block-no", "count-tx-outputs",
+                             "show-header-size", "validate"])
+    ap.add_argument("--validate", default="full",
+                    choices=["reapply", "full"],
+                    help="reapply: no crypto (snapshot-replay path); "
+                         "full: all proofs verified")
+    ap.add_argument("--backend", default="openssl",
+                    choices=["ref", "openssl", "jax"])
+    ap.add_argument("--window", type=int, default=256,
+                    help="blocks per device batch (full validation)")
+    args = ap.parse_args()
+
+    db, rules, decode = load_db(args.db)
+    out = sys.stdout
+    if args.analysis == "show-slot-block-no":
+        analysis_show_slot_block_no(db, decode, out)
+    elif args.analysis == "count-tx-outputs":
+        analysis_count_tx_outputs(db, decode, out)
+    elif args.analysis == "show-header-size":
+        analysis_show_header_size(db, decode, out)
+    else:
+        analysis_validate(db, rules, decode, args.backend, args.validate,
+                          args.window, out)
+
+
+if __name__ == "__main__":
+    main()

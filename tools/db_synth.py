@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""db-synth — forge an on-disk mock-Praos chain to replay with db-analyser.
+
+The role the reference's `db-converter` plays for its validate-mainnet CI
+gate (ouroboros-consensus-byron `db-converter`,
+ouroboros-consensus-byron/ouroboros-consensus-byron.cabal:82 +
+.buildkite/validate-mainnet.sh): produce an ImmutableDB the analyser can
+replay.  The chain carries the full Shelley-shaped proof mix — one ECVRF
+proof + one KES signature per header, Ed25519 tx witnesses per body
+(BASELINE.md configs #2-#4).
+
+Usage: python tools/db_synth.py --out DIR [--blocks N] [--txs-per-block M]
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", required=True, help="target directory")
+    ap.add_argument("--blocks", type=int, default=1000)
+    ap.add_argument("--txs-per-block", type=int, default=2)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--f", type=float, default=0.8)
+    ap.add_argument("--epoch-length", type=int, default=500)
+    ap.add_argument("--kes-depth", type=int, default=10)
+    ap.add_argument("--chunk-size", type=int, default=100)
+    ap.add_argument("--seed", default="db-synth")
+    args = ap.parse_args()
+
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    from ouroboros_tpu.consensus.headers import ProtocolBlock, make_header
+    from ouroboros_tpu.consensus.protocols.praos import (
+        HotKey, Praos, PraosConfig, PraosNode, praos_forge_fields,
+    )
+    from ouroboros_tpu.crypto import ed25519_ref, kes as kes_mod
+    from ouroboros_tpu.ledgers.mock import Tx, TxIn, TxOut
+    from ouroboros_tpu.storage.fs import IoFS
+    from ouroboros_tpu.storage.immutabledb import ImmutableDB
+
+    seed = args.seed.encode()
+
+    def h(tag: bytes, i: int) -> bytes:
+        return hashlib.blake2b(seed + tag + i.to_bytes(4, "big"),
+                               digest_size=32).digest()
+
+    n = args.nodes
+    vrf_sks = [h(b"vrf", i) for i in range(n)]
+    vrf_vks = [ed25519_ref.public_key(sk) for sk in vrf_sks]
+    kes_seeds = [h(b"kes", i) for i in range(n)]
+    kes_vks = [kes_mod.vk_of(args.kes_depth, s) for s in kes_seeds]
+    pay_sks = [h(b"pay", i) for i in range(n)]
+    pay_vks = [ed25519_ref.public_key(sk) for sk in pay_sks]
+    ssl_keys = [Ed25519PrivateKey.from_private_bytes(sk) for sk in pay_sks]
+
+    cfg = PraosConfig(
+        nodes=tuple(PraosNode(vrf_vks[i], kes_vks[i], 1) for i in range(n)),
+        k=2160, f=args.f, epoch_length=args.epoch_length,
+        kes_depth=args.kes_depth,
+        slots_per_kes_period=max(
+            1, (args.blocks * 4) // kes_mod.total_periods(args.kes_depth)))
+    protocol = Praos(cfg)
+    hot_keys = [HotKey(kes_mod.KesSignKey(args.kes_depth, s))
+                for s in kes_seeds]
+
+    genesis = {pay_vks[i].hex(): 10_000 for i in range(n)}
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "config.json"), "w") as fh:
+        json.dump({
+            "protocol": "mock-praos",
+            "k": cfg.k, "f": cfg.f, "epoch_length": cfg.epoch_length,
+            "kes_depth": cfg.kes_depth,
+            "slots_per_kes_period": cfg.slots_per_kes_period,
+            "nodes": [{"vrf_vk": vrf_vks[i].hex(),
+                       "kes_vk": kes_vks[i].hex(), "stake": 1}
+                      for i in range(n)],
+            "genesis": genesis,
+            "chunk_size": args.chunk_size,
+        }, fh, indent=2)
+
+    fs = IoFS(args.out)
+    db = ImmutableDB.open(fs, args.chunk_size, validate_all=False)
+
+    # spendable outputs per node, seeded from the genesis pseudo-tx whose
+    # outputs MockLedger indexes in sorted(vk) order
+    GEN = b"\x00" * 32
+    spendable: dict[int, list] = {}
+    for ix, vk in enumerate(sorted(pay_vks)):
+        spendable[pay_vks.index(vk)] = [(GEN, ix, 10_000)]
+
+    state = protocol.initial_chain_dep_state()
+    prev = None
+    slot = 0
+    forged = 0
+    t0 = time.time()
+    while forged < args.blocks:
+        view = None
+        ticked = protocol.tick_chain_dep_state(state, view, slot)
+        leader = None
+        for i in range(n):
+            pi = protocol.check_is_leader((i, vrf_sks[i]), slot, ticked,
+                                          view)
+            if pi is not None:
+                leader = (i, pi)
+                break
+        if leader is None:
+            slot += 1
+            continue
+        i, pi = leader
+        body = []
+        for t in range(args.txs_per_block):
+            owner = (forged * args.txs_per_block + t) % n
+            if not spendable[owner]:
+                continue
+            txid, ix, amount = spendable[owner].pop(0)
+            tx = Tx((TxIn(txid, ix),), (TxOut(pay_vks[owner], amount),))
+            sig = ssl_keys[owner].sign(tx.txid)
+            tx = Tx(tx.inputs, tx.outputs, ((pay_vks[owner], sig),))
+            spendable[owner].append((tx.txid, 0, amount))
+            body.append(tx)
+        hdr = make_header(prev, slot, body, issuer=i)
+        signed = praos_forge_fields(protocol, hot_keys[i], pi, hdr)
+        block = ProtocolBlock(signed, tuple(body))
+        db.append_block(block.slot, block.block_no, block.hash,
+                        block.prev_hash, block.bytes)
+        state = protocol.reupdate_chain_dep_state(ticked, signed, view)
+        prev = signed
+        forged += 1
+        slot += 1
+        if forged % 500 == 0:
+            print(f"  forged {forged}/{args.blocks} "
+                  f"({forged / (time.time() - t0):.0f} blocks/s)",
+                  file=sys.stderr)
+
+    print(json.dumps({"blocks": forged, "last_slot": slot - 1,
+                      "dir": args.out,
+                      "synth_secs": round(time.time() - t0, 2)}))
+
+
+if __name__ == "__main__":
+    main()
