@@ -192,9 +192,12 @@ class Mux:
             key = (sdu.num, 1 - sdu.mode)
             ch = self._channels.get(key)
             if ch is None:
-                raise MuxError(
-                    f"{self.label}: SDU for unknown protocol "
-                    f"{sdu.num}/{sdu.mode}")
+                # the reference's newMux registers every ingress queue of
+                # the MiniProtocolBundle before data can flow (responders
+                # start on demand — Mux.hs:264 StartOnDemand); our lazy
+                # registration gets the same effect by creating the queue
+                # here, buffering until the protocol attaches
+                ch = self.channel(sdu.num, 1 - sdu.mode)
 
             def put(tx, ch=ch, data=sdu.payload):
                 buf = tx.read(ch.ingress)

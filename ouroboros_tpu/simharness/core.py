@@ -197,6 +197,9 @@ class Sim:
         self._stm_waiters: dict[int, list[tuple[_Thread, int]]] = {}
 
     # -- tracing ------------------------------------------------------------
+    def now(self) -> float:
+        return self.time
+
     def _ev(self, thread: Optional[_Thread], kind: str, payload: Any = None):
         if self._collect:
             tid = thread.tid if thread else -1
@@ -268,7 +271,10 @@ class Sim:
     # -- main loop ----------------------------------------------------------
     def run(self, main: Coroutine, label: str = "main") -> Any:
         global _current_sim
+        from . import runtime as _runtime
         prev, _current_sim = _current_sim, self
+        prev_rt = _runtime.current_or_none()
+        _runtime.set_current(self)
         try:
             self._main = self._new_thread(main, label)
             while True:
@@ -315,6 +321,7 @@ class Sim:
                         self._ev(t, "cleanup-error", repr(exc))
                         interrupt = interrupt or exc
             _current_sim = prev
+            _runtime.set_current(prev_rt)
             if interrupt is not None:
                 raise interrupt
 

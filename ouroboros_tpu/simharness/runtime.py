@@ -1,0 +1,28 @@
+"""Runtime registry: which runtime (Sim or IoRuntime) is active.
+
+The io-sim-classes move (SURVEY.md §1 "the defining architectural move"):
+all node code is written against the simharness facade, and the facade
+dispatches to the active runtime — the deterministic simulator for tests,
+the asyncio-backed IO runtime for production.  One implementation, two
+interpreters, like `IOLike`'s IO/IOSim instances.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+_current = None
+
+
+def current():
+    if _current is None:
+        raise RuntimeError("not inside a simulation or IO runtime")
+    return _current
+
+
+def current_or_none():
+    return _current
+
+
+def set_current(rt) -> None:
+    global _current
+    _current = rt

@@ -54,9 +54,9 @@ class TVar:
         """Runtime-internal: write outside a transaction and wake STM
         waiters.  For non-sim-thread producers (timer callbacks, registration
         hooks); user code should write through atomically()."""
-        from . import core
+        from . import runtime
         self._value = value
-        core.current_sim().stm_notify([self._id])
+        runtime.current().stm_notify([self._id])
 
     def __repr__(self):
         return f"<TVar {self._id}{' ' + self.label if self.label else ''}={self._value!r}>"
