@@ -1,0 +1,107 @@
+"""CppBackend — the native CPU CryptoBackend over crypto/native/ouro_crypto.cpp.
+
+The libsodium role (SURVEY.md: the reference's hot crypto lives in external
+C reached through typeclass indirection — Shelley/Protocol/Crypto.hs:15-23):
+a fast scalar path for batch-of-1 operation when the node is caught up
+(BASELINE.json's fallback path), and the honest CPU baseline for replay
+benchmarks.  The shared library is compiled on demand with g++ and kept
+beside the source; bit-exactness versus ed25519_ref/vrf_ref is enforced by
+tests/test_cpp_backend.py.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import Optional, Sequence
+
+from . import kes as kes_mod
+from .backend import CryptoBackend, Ed25519Req, KesReq, VrfReq
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "native")
+_SRC = os.path.join(_NATIVE_DIR, "ouro_crypto.cpp")
+_LIB = os.path.join(_NATIVE_DIR, "libouro_crypto.so")
+_STAMP = os.path.join(_NATIVE_DIR, ".build-stamp")
+
+
+def _src_digest() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def build_library(force: bool = False) -> str:
+    """Compile the shared library if missing or stale; returns its path."""
+    digest = _src_digest()
+    if not force and os.path.exists(_LIB) and os.path.exists(_STAMP):
+        with open(_STAMP) as f:
+            if f.read().strip() == digest:
+                return _LIB
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC],
+        check=True, capture_output=True, text=True)
+    with open(_STAMP, "w") as f:
+        f.write(digest)
+    return _LIB
+
+
+def load_library():
+    lib = ctypes.CDLL(build_library())
+    lib.ouro_ed25519_verify.restype = ctypes.c_int
+    lib.ouro_ed25519_verify.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
+    lib.ouro_ed25519_verify_batch.restype = None
+    lib.ouro_vrf_verify.restype = ctypes.c_int
+    lib.ouro_vrf_verify.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
+    lib.ouro_vrf_verify_batch.restype = None
+    lib.ouro_vrf_proof_to_hash.restype = ctypes.c_int
+    return lib
+
+
+class CppBackend(CryptoBackend):
+    """Native scalar verification (ed25519 + ECVRF in C++; KES leaves via
+    the shared KES decomposition onto the ed25519 batch)."""
+
+    name = "cpu-native"
+
+    def __init__(self):
+        self.lib = load_library()
+
+    def verify_ed25519_batch(self, reqs: Sequence[Ed25519Req]) -> list[bool]:
+        if not reqs:
+            return []
+        n = len(reqs)
+        vks = b"".join(r.vk if len(r.vk) == 32 else b"\x00" * 32
+                       for r in reqs)
+        msgs = b"".join(r.msg for r in reqs)
+        lens = (ctypes.c_size_t * n)(*[len(r.msg) for r in reqs])
+        sigs = b"".join(r.sig if len(r.sig) == 64 else b"\x00" * 64
+                        for r in reqs)
+        out = (ctypes.c_uint8 * n)()
+        self.lib.ouro_ed25519_verify_batch(n, vks, msgs, lens, sigs, out)
+        return [bool(out[i]) and len(reqs[i].vk) == 32
+                and len(reqs[i].sig) == 64 for i in range(n)]
+
+    def verify_vrf_batch(self, reqs: Sequence[VrfReq]) -> list[bool]:
+        if not reqs:
+            return []
+        n = len(reqs)
+        vks = b"".join(r.vk if len(r.vk) == 32 else b"\x00" * 32
+                       for r in reqs)
+        alphas = b"".join(r.alpha for r in reqs)
+        alens = (ctypes.c_size_t * n)(*[len(r.alpha) for r in reqs])
+        pis = b"".join(r.proof if len(r.proof) == 80 else b"\x00" * 80
+                       for r in reqs)
+        out = (ctypes.c_uint8 * n)()
+        self.lib.ouro_vrf_verify_batch(n, vks, alphas, alens, pis, out)
+        return [bool(out[i]) and len(reqs[i].vk) == 32
+                and len(reqs[i].proof) == 80 for i in range(n)]
+
+    def vrf_proof_to_hash(self, proof: bytes) -> bytes:
+        beta = ctypes.create_string_buffer(64)
+        if len(proof) != 80 or \
+                not self.lib.ouro_vrf_proof_to_hash(proof, beta):
+            raise ValueError("invalid VRF proof")
+        return beta.raw

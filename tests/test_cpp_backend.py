@@ -1,0 +1,104 @@
+"""Native C++ backend: bit-exact parity with the Python reference crypto.
+
+The conformance surface the reference gets from libsodium test vectors
+(cardano-crypto-class) — here the pure-Python implementations are the
+oracle, and the native library must agree on valid AND corrupted inputs.
+"""
+import hashlib
+import random
+
+import pytest
+
+from ouroboros_tpu.crypto import ed25519_ref, kes as kes_mod, vrf_ref
+from ouroboros_tpu.crypto.backend import Ed25519Req, KesReq, VrfReq
+from ouroboros_tpu.crypto.cpp_backend import CppBackend
+
+
+@pytest.fixture(scope="module")
+def backend():
+    return CppBackend()
+
+
+def test_ed25519_parity(backend):
+    rng = random.Random(7)
+    reqs, expect = [], []
+    for i in range(20):
+        sk = hashlib.sha256(b"cpp-%d" % i).digest()
+        vk = ed25519_ref.public_key(sk)
+        msg = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 150)))
+        sig = ed25519_ref.sign(sk, msg)
+        reqs.append(Ed25519Req(vk, msg, sig))
+        expect.append(True)
+        bad = bytearray(sig)
+        bad[rng.randrange(64)] ^= 1 << rng.randrange(8)
+        reqs.append(Ed25519Req(vk, msg, bytes(bad)))
+        expect.append(ed25519_ref.verify(vk, msg, bytes(bad)))
+    got = backend.verify_ed25519_batch(reqs)
+    assert got == expect
+
+
+def test_ed25519_garbage_inputs(backend):
+    vk = b"\xff" * 32
+    assert backend.verify_ed25519_batch(
+        [Ed25519Req(vk, b"m", b"\x00" * 64),
+         Ed25519Req(b"short", b"m", b"\x00" * 64),
+         Ed25519Req(b"\x00" * 32, b"m", b"sig-too-short")]) == \
+        [False, False, False]
+
+
+def test_vrf_parity(backend):
+    rng = random.Random(8)
+    reqs, expect = [], []
+    for i in range(8):
+        sk = hashlib.sha256(b"cppv-%d" % i).digest()
+        vk = ed25519_ref.public_key(sk)
+        alpha = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 64)))
+        pi = vrf_ref.prove(sk, alpha)
+        reqs.append(VrfReq(vk, alpha, pi))
+        expect.append(True)
+        bad = bytearray(pi)
+        bad[rng.randrange(80)] ^= 1 << rng.randrange(8)
+        reqs.append(VrfReq(vk, alpha, bytes(bad)))
+        expect.append(vrf_ref.verify(vk, alpha, bytes(bad)))
+    got = backend.verify_vrf_batch(reqs)
+    assert got == expect
+
+
+def test_vrf_proof_to_hash_parity(backend):
+    sk = hashlib.sha256(b"beta").digest()
+    pi = vrf_ref.prove(sk, b"alpha")
+    assert backend.vrf_proof_to_hash(pi) == vrf_ref.proof_to_hash(pi)
+    # the all-zero proof is a VALID encoding (y=0 decompresses) — both
+    # implementations must agree on it too
+    assert backend.vrf_proof_to_hash(b"\x00" * 80) == \
+        vrf_ref.proof_to_hash(b"\x00" * 80)
+    # s >= L is an invalid encoding in both
+    bad = pi[:48] + b"\xff" * 32
+    with pytest.raises(ValueError):
+        backend.vrf_proof_to_hash(bad)
+    with pytest.raises(ValueError):
+        vrf_ref.proof_to_hash(bad)
+
+
+def test_kes_via_native_leaves(backend):
+    """KES decomposition (shared CryptoBackend path) over native ed25519."""
+    key = kes_mod.KesSignKey(4, hashlib.sha256(b"cpp-kes").digest())
+    vk = key.verification_key
+    sigs = []
+    for period in range(3):
+        sigs.append((period, key.sign(b"msg-%d" % period).to_bytes()))
+        key.evolve()
+    reqs = [KesReq(depth=4, vk=vk, period=p, msg=b"msg-%d" % p,
+                   sig_bytes=s) for p, s in sigs]
+    reqs.append(KesReq(depth=4, vk=vk, period=0, msg=b"wrong",
+                       sig_bytes=sigs[0][1]))
+    assert backend.verify_kes_batch(reqs) == [True, True, True, False]
+
+
+def test_build_is_cached():
+    from ouroboros_tpu.crypto.cpp_backend import build_library
+    import time
+    p1 = build_library()
+    t0 = time.time()
+    p2 = build_library()
+    assert p1 == p2 and time.time() - t0 < 0.05   # cache hit, no recompile

@@ -72,6 +72,9 @@ def make_backend(name: str):
         return CpuRefBackend()
     if name == "openssl":
         return OpensslBackend()
+    if name == "cpp":
+        from ouroboros_tpu.crypto.cpp_backend import CppBackend
+        return CppBackend()
     if name == "jax":
         from ouroboros_tpu.crypto.jax_backend import JaxBackend
         return JaxBackend()
@@ -162,7 +165,7 @@ def main() -> None:
                     help="reapply: no crypto (snapshot-replay path); "
                          "full: all proofs verified")
     ap.add_argument("--backend", default="openssl",
-                    choices=["ref", "openssl", "jax"])
+                    choices=["ref", "openssl", "cpp", "jax"])
     ap.add_argument("--window", type=int, default=256,
                     help="blocks per device batch (full validation)")
     args = ap.parse_args()
