@@ -1,5 +1,6 @@
-"""Mini-protocols: ChainSync, BlockFetch, TxSubmission, KeepAlive,
-Handshake, LocalStateQuery, LocalTxSubmission.
+"""Mini-protocols: ChainSync, BlockFetch, TxSubmission(+2 via Hello),
+KeepAlive, Handshake, LocalStateQuery, LocalTxSubmission, LocalTxMonitor,
+TipSample, plus the PingPong/ReqResp teaching protocols.
 
 Reference: ouroboros-network/src/Ouroboros/Network/Protocol/*/Type.hs state
 machines, rebuilt as ProtocolSpecs + message dataclasses + async peers.

@@ -1,0 +1,132 @@
+"""NTP client tests (reference: ntp-client/src/Network/NTP/Client.hs +
+Client/{Query,Packet}.hs): packet codec, offset math, quorum, poll loop,
+error backoff, forced re-query."""
+import pytest
+
+from ouroboros_tpu import simharness as sim
+from ouroboros_tpu.node.ntp_client import (
+    Drift, NtpClient, NtpPacket, NtpSettings, PENDING, UNAVAILABLE,
+    clock_offset, minimum_of_some,
+)
+
+
+def test_packet_roundtrip():
+    p = NtpPacket(origin_time=1_000_000.5, receive_time=1_000_010.25,
+                  transmit_time=1_000_010.75)
+    q = NtpPacket.decode(p.encode())
+    for a, b in [(p.origin_time, q.origin_time),
+                 (p.receive_time, q.receive_time),
+                 (p.transmit_time, q.transmit_time)]:
+        assert abs(a - b) < 1e-6
+    with pytest.raises(ValueError):
+        NtpPacket.decode(b"short")
+
+
+def test_clock_offset_symmetric_path():
+    # server clock 2.0s ahead; symmetric 0.1s path each way
+    t0 = 100.0
+    reply = NtpPacket(origin_time=t0, receive_time=t0 + 0.1 + 2.0,
+                      transmit_time=t0 + 0.1 + 2.0)
+    t3 = t0 + 0.2
+    assert abs(clock_offset(reply, t3) - 2.0) < 1e-9
+
+
+def test_minimum_of_some_quorum():
+    assert minimum_of_some(3, [0.5, -0.2, 1.0]) == -0.2
+    assert minimum_of_some(3, [0.5, -0.2]) is None
+    assert minimum_of_some(0, [0.7]) == 0.7
+
+
+def _server_transport(offsets, drop=frozenset()):
+    """Scripted transport: server i replies with its clock shifted by
+    offsets[i]; indices in `drop` never answer."""
+    async def transport(server, data, timeout):
+        if server in drop:
+            await sim.sleep(timeout)
+            return None
+        req = NtpPacket.decode(data)
+        await sim.sleep(0.05)                      # one-way delay
+        now = sim.now() + offsets[server]
+        # RFC 5905: server echoes the request's TRANSMIT time as origin
+        reply = NtpPacket(origin_time=req.transmit_time,
+                          receive_time=now, transmit_time=now)
+        await sim.sleep(0.05)                      # return path
+        return reply.encode()
+    return transport
+
+
+def test_query_once_measures_drift():
+    async def main():
+        client = NtpClient(
+            NtpSettings(servers=(0, 1, 2), required_results=3),
+            _server_transport({0: 1.5, 1: 1.52, 2: 1.48}))
+        return await client.query_once()
+
+    status = sim.run(main())
+    assert isinstance(status, Drift)
+    assert abs(status.offset - 1.48) < 1e-6      # min |offset| of the three
+
+
+def test_query_unavailable_below_quorum():
+    async def main():
+        client = NtpClient(
+            NtpSettings(servers=(0, 1, 2), required_results=3,
+                        response_timeout=0.5),
+            _server_transport({0: 1.0, 1: 1.0, 2: 1.0}, drop={1, 2}))
+        return await client.query_once()
+
+    assert sim.run(main()) == UNAVAILABLE
+
+
+def test_poll_loop_and_forced_requery():
+    async def main():
+        client = NtpClient(
+            NtpSettings(servers=(0,), required_results=1, poll_delay=100.0),
+            _server_transport({0: 3.0}))
+        client.start()
+        st1 = await client.query_blocking()
+        t_first = sim.now()
+        # force an early re-query long before poll_delay elapses
+        await sim.sleep(5.0)
+        st2 = await client.query_blocking()
+        client.stop()
+        return st1, st2, sim.now() - t_first
+
+    st1, st2, dt = sim.run(main())
+    assert isinstance(st1, Drift) and isinstance(st2, Drift)
+    assert dt < 10.0       # re-query happened without waiting 100s
+
+
+def test_spoofed_origin_rejected():
+    async def main():
+        async def spoofing(server, data, timeout):
+            now = sim.now() + 1.0
+            # origin does NOT echo our transmit -> must be dropped
+            return NtpPacket(origin_time=12345.0, receive_time=now,
+                             transmit_time=now).encode()
+
+        client = NtpClient(
+            NtpSettings(servers=(0,), required_results=1), spoofing)
+        return await client.query_once()
+
+    assert sim.run(main()) == UNAVAILABLE
+
+
+def test_error_backoff_doubles():
+    delays = []
+
+    async def main():
+        client = NtpClient(
+            NtpSettings(servers=(0,), required_results=1,
+                        response_timeout=0.1, initial_error_delay=5.0),
+            _server_transport({0: 0.0}, drop={0}),
+            tracer=lambda ev: delays.append(ev[1])
+            if ev[0] == "ntp.retry_delay" else None)
+        client.start()
+        await sim.sleep(40.0)
+        client.stop()
+        return client.get_status()
+
+    status = sim.run(main())
+    assert status == UNAVAILABLE
+    assert delays[:3] == [5.0, 10.0, 20.0]

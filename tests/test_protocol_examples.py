@@ -1,0 +1,79 @@
+"""PingPong + ReqResp fixture-protocol tests (typed-protocols-examples
+parity): codec round-trips, direct runs, pipelined == unpipelined."""
+import pytest
+
+from ouroboros_tpu import simharness as sim
+from ouroboros_tpu.network import typed
+from ouroboros_tpu.network.protocols import examples as ex
+from ouroboros_tpu.network.protocols.codec import roundtrip_property
+from ouroboros_tpu.network.typed import (CLIENT, SERVER, ProtocolError,
+                                         run_peer)
+from ouroboros_tpu.network.channel import channel_pair
+
+
+def test_example_codecs_roundtrip():
+    assert roundtrip_property(ex.PING_PONG_CODEC, [
+        ex.MsgPing(), ex.MsgPong(), ex.MsgPingDone()])
+    assert roundtrip_property(ex.REQ_RESP_CODEC, [
+        ex.MsgReq([1, "x"]), ex.MsgResp(42), ex.MsgReqDone()])
+
+
+def test_ping_pong_direct():
+    async def main():
+        return await typed.connect(
+            ex.PING_PONG_SPEC,
+            lambda s: ex.ping_pong_client(s, rounds=7),
+            ex.ping_pong_server)
+
+    pongs, served = sim.run(main())
+    assert pongs == 7 and served == 7
+
+
+def test_req_resp_direct():
+    async def main():
+        return await typed.connect(
+            ex.REQ_RESP_SPEC,
+            lambda s: ex.req_resp_client(s, list(range(5))),
+            lambda s: ex.req_resp_server(s, lambda x: x * x))
+
+    out, served = sim.run(main())
+    assert out == [0, 1, 4, 9, 16] and served == 5
+
+
+def test_req_resp_pipelined_equals_unpipelined():
+    reqs = list(range(9))
+
+    def run_variant(pipelined):
+        async def main():
+            ca, cb = channel_pair(capacity=32, delay=0.01, label="rr")
+            client_fn = (ex.req_resp_client_pipelined if pipelined
+                         else ex.req_resp_client)
+            ch = sim.spawn(run_peer(ex.REQ_RESP_SPEC, CLIENT, ca,
+                                    lambda s: client_fn(s, reqs),
+                                    pipelined=pipelined),
+                           label="rr.client")
+            sh = sim.spawn(run_peer(ex.REQ_RESP_SPEC, SERVER, cb,
+                                    lambda s: ex.req_resp_server(
+                                        s, lambda x: x + 100)),
+                           label="rr.server")
+            return await ch.wait(), await sh.wait()
+
+        return sim.run(main())
+
+    out_plain, _ = run_variant(False)
+    out_pipe, _ = run_variant(True)
+    assert out_plain == out_pipe == [x + 100 for x in reqs]
+
+
+def test_ping_pong_agency_enforced():
+    async def main():
+        async def bad_server(s):
+            await s.send(ex.MsgPong())   # server has no agency in PPIdle
+
+        async def client(s):
+            await s.recv()
+
+        return await typed.connect(ex.PING_PONG_SPEC, client, bad_server)
+
+    with pytest.raises(ProtocolError):
+        sim.run(main())

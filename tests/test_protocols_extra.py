@@ -1,0 +1,166 @@
+"""Tests for TipSample, LocalTxMonitor, and the Hello transformer /
+TxSubmission2 (reference: Protocol/TipSample, Protocol/LocalTxMonitor,
+Protocol/Trans/Hello + Protocol/TxSubmission2)."""
+import pytest
+
+from ouroboros_tpu import simharness as sim
+from ouroboros_tpu.chain import Tip, make_block, point_of
+from ouroboros_tpu.network import typed
+from ouroboros_tpu.network.protocols import (
+    localtxmonitor, tipsample, txsubmission2,
+)
+from ouroboros_tpu.network.protocols.codec import roundtrip_property
+from ouroboros_tpu.network.typed import ProtocolError
+
+
+def mk_tips(n):
+    out, prev = [], None
+    for i in range(n):
+        prev = make_block(prev, i * 2 + 1, body=[b"tx%d" % i])
+        out.append(Tip(point_of(prev), prev.block_no))
+    return out
+
+
+def test_tipsample_codec_roundtrip():
+    t = mk_tips(1)[0]
+    assert roundtrip_property(tipsample.CODEC, [
+        tipsample.MsgFollowTip(3, 17), tipsample.MsgNextTip(t),
+        tipsample.MsgNextTipDone(t), tipsample.MsgDone()])
+
+
+def test_localtxmonitor_codec_roundtrip():
+    assert roundtrip_property(localtxmonitor.CODEC, [
+        localtxmonitor.MsgRequestTx(), localtxmonitor.MsgReplyTx(b"tx"),
+        localtxmonitor.MsgDone()])
+
+
+def test_txsubmission2_codec_has_hello():
+    assert roundtrip_property(txsubmission2.CODEC, [
+        txsubmission2.MsgHello(),
+        txsubmission2.MsgRequestTxIds(False, 0, 4)])
+    # hello tag is 6 on the wire (TxSubmission2/Codec.hs:62-63)
+    raw = txsubmission2.CODEC.encode(txsubmission2.MsgHello())
+    assert txsubmission2.CODEC.decode(raw) == txsubmission2.MsgHello()
+    assert txsubmission2.MsgHello.TAG == 6
+
+
+def test_tipsample_direct():
+    tips = mk_tips(6)
+
+    async def main():
+        cursor = [0]
+
+        async def source(slot, after):
+            t = tips[cursor[0] % len(tips)]
+            cursor[0] += 1
+            return t
+
+        async def client(s):
+            return await tipsample.client_sample(s, [(2, 0), (3, 10)])
+
+        async def server(s):
+            return await tipsample.server_from_tip_source(s, source)
+
+        return await typed.connect(tipsample.SPEC, client, server)
+
+    (rounds, _) = sim.run(main())
+    assert [len(r) for r in rounds] == [2, 3]
+    assert rounds[0] == tips[:2] and rounds[1] == tips[2:5]
+
+
+def test_tipsample_server_miscount_detected():
+    async def main():
+        async def bad_server(s):
+            msg = await s.recv()                 # MsgFollowTip(n>=2, _)
+            t = mk_tips(1)[0]
+            await s.send(tipsample.MsgNextTipDone(t))   # ends after 1 of n
+            await s.recv()
+
+        async def client(s):
+            return await tipsample.client_sample(s, [(3, 0)])
+
+        return await typed.connect(tipsample.SPEC, client, bad_server)
+
+    with pytest.raises(RuntimeError, match="ended after 1 tips"):
+        sim.run(main())
+
+
+def test_localtxmonitor_streams_mempool():
+    class FakeMempool:
+        def __init__(self, txs):
+            self.txs = list(txs)
+            self.waiters = sim.TQueue() if hasattr(sim, "TQueue") else None
+
+        def snapshot_txs(self):
+            return list(self.txs)
+
+        async def wait_for_new(self, seen):
+            while len(self.txs) <= seen:
+                await sim.sleep(0.1)
+
+    mp = FakeMempool([b"tx-a", b"tx-b"])
+
+    async def main():
+        async def feeder():
+            await sim.sleep(1.0)
+            mp.txs.append(b"tx-c")
+
+        sim.spawn(feeder(), label="feeder")
+
+        async def client(s):
+            return await localtxmonitor.client_collect(s, 3)
+
+        async def server(s):
+            return await localtxmonitor.server_from_mempool(s, mp)
+
+        return await typed.connect(localtxmonitor.SPEC, client, server)
+
+    (got, _) = sim.run(main())
+    assert got == [b"tx-a", b"tx-b", b"tx-c"]
+
+
+def test_txsubmission2_relay_with_hello():
+    class Reader:
+        def __init__(self, txs):
+            self.txs = list(txs)
+            self.cursor = 0
+
+        def next_ids(self, n):
+            out = [(i, len(t)) for i, t in
+                   self.txs[self.cursor:self.cursor + n]]
+            self.cursor += len(out)
+            return out
+
+        def lookup(self, txid):
+            return dict(self.txs).get(txid)
+
+    txs = [(b"id%d" % i, b"payload-%d" % i) for i in range(12)]
+    got = []
+
+    async def main():
+        reader = Reader(txs)
+        return await typed.connect(
+            txsubmission2.SPEC,
+            lambda s: txsubmission2.outbound_from_mempool(s, reader),
+            lambda s: txsubmission2.inbound_collect(
+                s, got.append, window=5))
+
+    sim.run(main())
+    assert sorted(got) == sorted(t for _, t in txs)
+
+
+def test_txsubmission2_requires_hello_first():
+    async def main():
+        async def outbound_skips_hello(s):
+            # still in state "Hello" (client agency) — sending a reply
+            # is an agency/transition violation
+            await s.send(txsubmission2.MsgReplyTxIds(()))
+
+        async def inbound(s):
+            await s.recv()
+
+        return await typed.connect(
+            txsubmission2.SPEC, outbound_skips_hello, inbound)
+
+    with pytest.raises(ProtocolError):
+        sim.run(main())
