@@ -53,12 +53,17 @@ class HeaderState:
 
 def validate_envelope(header: Any, header_state: HeaderState) -> None:
     """The cheap structural checks (HeaderValidation.hs:278-349):
-    block number increments, slot strictly increases, prev hash links."""
+    block number increments, slot strictly increases, prev hash links.
+
+    Epoch-boundary blocks (header field "ebb", the Byron-era quirk of
+    Block/EBB.hs + the era-specific `ValidateEnvelope` instances) share
+    their predecessor's block number instead of incrementing it."""
     tip = header_state.tip
+    is_ebb = bool(header.get("ebb", 0)) if hasattr(header, "get") else False
     if tip is None:
         expected_block_no, min_slot, expected_prev = 0, 0, GENESIS_HASH
     else:
-        expected_block_no = tip.block_no + 1
+        expected_block_no = tip.block_no if is_ebb else tip.block_no + 1
         min_slot = tip.slot + 1
         expected_prev = tip.hash
     if header.block_no != expected_block_no:

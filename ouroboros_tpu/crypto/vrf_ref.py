@@ -58,6 +58,12 @@ def prove(sk: bytes, alpha: bytes) -> bytes:
         + int.to_bytes(s, 32, "little")
 
 
+def public_key(sk: bytes) -> bytes:
+    """VRF verification key Y = [x]B for the 32-byte secret seed."""
+    x, _ = _secret_expand(sk)
+    return ed.compress(ed.scalar_mult(x, BASE))
+
+
 def _secret_expand(sk: bytes) -> tuple[int, bytes]:
     h = ed.sha512(sk)
     a = bytearray(h[:32])

@@ -1,0 +1,19 @@
+"""Era instantiations (the L6 layer of SURVEY.md §1).
+
+- shelley.py — TPraos protocol + stake-pool UTxO ledger
+  (ouroboros-consensus-shelley analog)
+- byron.py   — PBFT era with EBBs + delegation (ouroboros-consensus-byron
+  analog)
+- cardano.py — the mainnet-shaped hard-fork composition
+  (ouroboros-consensus-cardano analog)
+"""
+from .byron import (                                       # noqa: F401
+    ByronLedger, ByronLedgerState, ByronLedgerView, ByronPBft, ByronTx,
+    byron_genesis_setup, byron_sign_header, make_byron_tx, make_ebb,
+)
+from .shelley import (                                     # noqa: F401
+    OCert, PoolInfo, ShelleyLedger, ShelleyLedgerState, ShelleyTx,
+    TPraos, TPraosCanBeLeader, TPraosConfig, TPraosIsLeader,
+    TPraosLedgerView, TPraosState, forge_tpraos_fields, make_ocert,
+    make_shelley_tx, pool_id_of, shelley_genesis_setup,
+)
