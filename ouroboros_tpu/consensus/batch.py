@@ -72,7 +72,7 @@ def validate_headers_batched(
     for i, h in enumerate(headers):
         view = ledger_view_for(i, h)
         try:
-            validate_envelope(h, st)
+            validate_envelope(h, st, protocol)
             ticked = protocol.tick_chain_dep_state(
                 st.chain_dep_state, view, h.slot)
             protocol.sequential_checks(ticked, h, view)
@@ -125,7 +125,7 @@ def validate_blocks_batched(
         header = getattr(b, "header", b)
         view = ledger.ledger_view(st.ledger)
         try:
-            validate_envelope(header, st.header)
+            validate_envelope(header, st.header, protocol)
             ticked_dep = protocol.tick_chain_dep_state(
                 st.header.chain_dep_state, view, header.slot)
             protocol.sequential_checks(ticked_dep, header, view)

@@ -168,6 +168,10 @@ class HardForkProtocol(ConsensusProtocol):
     def __init__(self, eras: Sequence[Era]):
         self.eras = list(eras)
         self.security_param = max(e.protocol.security_param for e in eras)
+        # Envelope-level EBB admission: true if ANY era has EBBs; the exact
+        # era is enforced by the era tag + each protocol's own checks.
+        self.accepts_ebb = any(getattr(e.protocol, "accepts_ebb", False)
+                               for e in eras)
 
     def initial_chain_dep_state(self) -> HardForkState:
         return HardForkState(0, self.eras[0].protocol
@@ -203,8 +207,17 @@ class HardForkProtocol(ConsensusProtocol):
         if tagged != ticked.era:
             raise ProtocolError(
                 f"header tagged era {tagged}, expected {ticked.era}")
-        self.eras[ticked.era].protocol.sequential_checks(
-            ticked.inner, header, ledger_view.inner)
+        era_protocol = self.eras[ticked.era].protocol
+        # the combinator-level accepts_ebb is the union over eras; enforce
+        # the CURRENT era's admission here (protocols that predate the ebb
+        # field would otherwise grant the block_no non-increment exemption)
+        if header.get("ebb") and not getattr(era_protocol, "accepts_ebb",
+                                             False):
+            raise ProtocolError(
+                f"EBB header in era {self.eras[ticked.era].name}, which "
+                f"admits no EBBs")
+        era_protocol.sequential_checks(ticked.inner, header,
+                                       ledger_view.inner)
 
     def extract_proofs(self, ticked: HardForkState, header,
                        ledger_view: HardForkLedgerView) -> list:

@@ -26,10 +26,14 @@ class HeaderEnvelopeError(HeaderError):
 
 @dataclass(frozen=True)
 class AnnTip:
-    """Annotated tip of the validated header chain (HeaderValidation.hs:97)."""
+    """Annotated tip of the validated header chain (HeaderValidation.hs:97).
+
+    is_ebb mirrors the reference's TipInfoIsEBB: a Byron EBB's successor is
+    allowed to occupy the same slot (minimumNextSlotNo)."""
     slot: int
     block_no: int
     hash: bytes
+    is_ebb: bool = False
 
     @property
     def point(self) -> Point:
@@ -51,20 +55,30 @@ class HeaderState:
         return self.tip.point if self.tip else Point.genesis()
 
 
-def validate_envelope(header: Any, header_state: HeaderState) -> None:
+def validate_envelope(header: Any, header_state: HeaderState,
+                      protocol: Optional[ConsensusProtocol] = None) -> None:
     """The cheap structural checks (HeaderValidation.hs:278-349):
     block number increments, slot strictly increases, prev hash links.
 
     Epoch-boundary blocks (header field "ebb", the Byron-era quirk of
     Block/EBB.hs + the era-specific `ValidateEnvelope` instances) share
-    their predecessor's block number instead of incrementing it."""
+    their predecessor's block number instead of incrementing it; only
+    protocols declaring `accepts_ebb` admit them (Shelley-family eras have
+    none), and an EBB's successor may share the EBB's slot
+    (minimumNextSlotNo)."""
     tip = header_state.tip
-    is_ebb = bool(header.get("ebb", 0)) if hasattr(header, "get") else False
+    is_ebb = _is_ebb(header)
+    if is_ebb and protocol is not None \
+            and not getattr(protocol, "accepts_ebb", False):
+        raise HeaderEnvelopeError(
+            "EBB header in an era whose protocol admits no EBBs")
     if tip is None:
         expected_block_no, min_slot, expected_prev = 0, 0, GENESIS_HASH
     else:
         expected_block_no = tip.block_no if is_ebb else tip.block_no + 1
-        min_slot = tip.slot + 1
+        # only the REAL block following an EBB may share its slot; an EBB
+        # can never reuse its predecessor's slot
+        min_slot = tip.slot if (tip.is_ebb and not is_ebb) else tip.slot + 1
         expected_prev = tip.hash
     if header.block_no != expected_block_no:
         raise HeaderEnvelopeError(
@@ -79,11 +93,19 @@ def validate_envelope(header: Any, header_state: HeaderState) -> None:
             f"{header.prev_hash.hex()[:16]} != {expected_prev.hex()[:16]}")
 
 
+def _is_ebb(header: Any) -> bool:
+    return bool(header.get("ebb", 0)) if hasattr(header, "get") else False
+
+
+def ann_tip_of(header: Any) -> AnnTip:
+    return AnnTip(header.slot, header.block_no, header.hash, _is_ebb(header))
+
+
 def validate_header(protocol: ConsensusProtocol, ledger_view: Any,
                     header: Any, header_state: HeaderState,
                     backend=None) -> HeaderState:
     """Envelope + full crypto chain-dep update (validateHeader, :413-432)."""
-    validate_envelope(header, header_state)
+    validate_envelope(header, header_state, protocol)
     ticked = protocol.tick_chain_dep_state(
         header_state.chain_dep_state, ledger_view, header.slot)
     try:
@@ -91,20 +113,18 @@ def validate_header(protocol: ConsensusProtocol, ledger_view: Any,
             ticked, header, ledger_view, backend=backend)
     except Exception as e:
         raise HeaderError(f"chain-dep update failed: {e}") from e
-    return HeaderState(
-        AnnTip(header.slot, header.block_no, header.hash), new_dep)
+    return HeaderState(ann_tip_of(header), new_dep)
 
 
 def revalidate_header(protocol: ConsensusProtocol, ledger_view: Any,
                       header: Any, header_state: HeaderState) -> HeaderState:
     """Re-apply a previously-validated header, no crypto (revalidateHeader,
     :436)."""
-    validate_envelope(header, header_state)
+    validate_envelope(header, header_state, protocol)
     ticked = protocol.tick_chain_dep_state(
         header_state.chain_dep_state, ledger_view, header.slot)
     new_dep = protocol.reupdate_chain_dep_state(ticked, header, ledger_view)
-    return HeaderState(
-        AnnTip(header.slot, header.block_no, header.hash), new_dep)
+    return HeaderState(ann_tip_of(header), new_dep)
 
 
 class HeaderStateHistory:

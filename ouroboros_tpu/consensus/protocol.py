@@ -36,6 +36,11 @@ class ConsensusProtocol:
 
     security_param: int = 2160
 
+    # Whether this protocol's era admits epoch-boundary blocks; consulted by
+    # validate_envelope (the reference gates EBBs per era via
+    # ValidateEnvelope — only Byron has them).
+    accepts_ebb: bool = False
+
     # -- chain-dependent state ------------------------------------------------
     def initial_chain_dep_state(self) -> Any:
         raise NotImplementedError

@@ -49,6 +49,7 @@ class WithLeaderSchedule(ConsensusProtocol):
         self.schedule = schedule
         self.node_id = node_id
         self.security_param = inner.security_param
+        self.accepts_ebb = getattr(inner, "accepts_ebb", False)
 
     def initial_chain_dep_state(self):
         return ()
@@ -85,6 +86,7 @@ class ModChainSel(ConsensusProtocol):
         self.view = view
         self.prefer = prefer
         self.security_param = inner.security_param
+        self.accepts_ebb = getattr(inner, "accepts_ebb", False)
 
     def initial_chain_dep_state(self):
         return self.inner.initial_chain_dep_state()

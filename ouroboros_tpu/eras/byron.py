@@ -65,6 +65,8 @@ class ByronPBft(ConsensusProtocol):
     bounded by `window` — PBFT/State.hs.
     """
 
+    accepts_ebb = True                 # Byron is the EBB era (Block/EBB.hs)
+
     def __init__(self, n_genesis_keys: int, threshold: float = 0.22,
                  window: int = 100, k: int = 5, epoch_length: int = 100):
         self.n = n_genesis_keys
@@ -97,6 +99,11 @@ class ByronPBft(ConsensusProtocol):
             if header.get(SIG_FIELD) is not None or header.body_hash != \
                     _EBB_BODY_HASH:
                 raise ProtocolError("Byron: malformed EBB")
+            # canBeEBB: EBBs only occupy the first slot of an epoch
+            if header.slot % self.epoch_length != 0:
+                raise ProtocolError(
+                    f"Byron: EBB at slot {header.slot}, not an epoch "
+                    f"boundary (epoch_length={self.epoch_length})")
             return
         if not (0 <= header.issuer < self.n):
             raise ProtocolError(
@@ -204,9 +211,12 @@ class ByronTx:
 
     @classmethod
     def decode(cls, obj) -> "ByronTx":
+        outputs = tuple((bytes(a), int(m)) for a, m in obj[1])
+        if any(m < 0 for _a, m in outputs):
+            raise ValueError("negative output amount")
         return cls(
             tuple((bytes(t), int(i)) for t, i in obj[0]),
-            tuple((bytes(a), int(m)) for a, m in obj[1]),
+            outputs,
             tuple((str(c[0]), bytes(c[1]), bytes(c[2])) for c in obj[2]),
             tuple((bytes(vk), bytes(sig)) for vk, sig in obj[3]))
 
@@ -290,11 +300,17 @@ class ByronLedger(LedgerRules):
         delegates = list(state.delegates)
         update_epoch = state.update_epoch
         for tx in block.body:
+            if len(set(tx.inputs)) != len(tx.inputs):
+                raise LedgerError(
+                    f"tx {tx.txid.hex()[:12]} has duplicate inputs")
             spent = 0
             for txid, ix in tx.inputs:
                 if (txid, ix) not in utxo:
                     raise LedgerError(f"missing input {txid.hex()[:12]}#{ix}")
                 spent += utxo[(txid, ix)][1]
+            if any(m < 0 for _a, m in tx.outputs):
+                raise LedgerError(
+                    f"tx {tx.txid.hex()[:12]} has a negative output")
             if sum(m for _a, m in tx.outputs) > spent:
                 raise LedgerError(f"tx {tx.txid.hex()[:12]} overspends")
             for kind, arg, vk in tx.certs:

@@ -277,6 +277,8 @@ class TPraos(ConsensusProtocol):
     def sequential_checks(self, ticked: TPraosState, header,
                           ledger_view: TPraosLedgerView) -> None:
         cfg = self.config
+        if header.get("ebb"):
+            raise ProtocolError("TPraos: Shelley admits no EBBs")
         issuer_vk, ocert, pi_eta, pi_leader, _ = self._decode_header(header)
         pid = pool_id_of(issuer_vk)
         pool = ledger_view.get(pid)
@@ -306,6 +308,13 @@ class TPraos(ConsensusProtocol):
             raise ProtocolError(
                 f"TPraos: OCert issue number {ocert.counter} regressed "
                 f"below {ticked.counter_of(pid)}")
+        # OCERT rule bounds the new issue number: m <= n <= m+1, where a
+        # pool with no recorded counter defaults to m=0 (so n in {0, 1})
+        current = max(ticked.counter_of(pid), 0)
+        if ocert.counter > current + 1:
+            raise ProtocolError(
+                f"TPraos: OCert issue number {ocert.counter} jumps past "
+                f"{current} + 1")
 
     def extract_proofs(self, ticked: TPraosState, header,
                        ledger_view: TPraosLedgerView) -> list:
@@ -456,11 +465,14 @@ class ShelleyTx:
 
     @classmethod
     def decode(cls, obj) -> "ShelleyTx":
+        outputs = tuple((bytes(o[0]), int(o[1]),
+                         tuple((bytes(a), int(q)) for a, q in o[2]))
+                        for o in obj[1])
+        if any(m < 0 for _a, m, _assets in outputs):
+            raise ValueError("negative output amount")
         return cls(
             tuple((bytes(t), int(i)) for t, i in obj[0]),
-            tuple((bytes(o[0]), int(o[1]),
-                   tuple((bytes(a), int(q)) for a, q in o[2]))
-                  for o in obj[1]),
+            outputs,
             tuple((str(c[0]), bytes(c[1]), bytes(c[2])) for c in obj[2]),
             tuple((bytes(vk), bytes(sig)) for vk, sig in obj[5]),
             tuple(int(v) for v in obj[3]),
@@ -645,6 +657,9 @@ class ShelleyLedger(LedgerRules):
         pools = dict(state.pools)
         for tx in block.body:
             self._check_features(tx, block.slot)
+            if len(set(tx.inputs)) != len(tx.inputs):
+                raise LedgerError(
+                    f"tx {tx.txid.hex()[:12]} has duplicate inputs")
             spent = 0
             consumed_assets: dict = {}
             for txid, ix in tx.inputs:
@@ -661,6 +676,10 @@ class ShelleyLedger(LedgerRules):
             produced = 0
             produced_assets: dict = {}
             for _addr, amount, assets in tx.outputs:
+                # Coin is non-negative by construction in the reference
+                if amount < 0:
+                    raise LedgerError(
+                        f"tx {tx.txid.hex()[:12]} has a negative output")
                 produced += amount
                 for aid, qty in assets:
                     if qty <= 0:

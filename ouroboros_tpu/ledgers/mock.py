@@ -135,6 +135,9 @@ class MockLedger(LedgerRules):
     def _apply_txs(self, state: MockLedgerState, block) -> MockLedgerState:
         utxo = state.utxo_dict()
         for tx in block.body:
+            if len({(i.txid, i.ix) for i in tx.inputs}) != len(tx.inputs):
+                raise LedgerError(
+                    f"tx {tx.txid.hex()[:12]} has duplicate inputs")
             spent = 0
             for i in tx.inputs:
                 key = (i.txid, i.ix)
@@ -142,6 +145,9 @@ class MockLedger(LedgerRules):
                     raise LedgerError(
                         f"missing input {i.txid.hex()[:12]}#{i.ix}")
                 spent += utxo[key][1]
+            if any(o.amount < 0 for o in tx.outputs):
+                raise LedgerError(
+                    f"tx {tx.txid.hex()[:12]} has a negative output")
             produced = sum(o.amount for o in tx.outputs)
             if produced > spent:
                 raise LedgerError(

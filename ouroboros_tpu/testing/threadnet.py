@@ -175,7 +175,8 @@ class PraosNetworkFactory:
         tip = ext.header.tip
         return [list(ext.ledger.utxo), ext.ledger.slot,
                 ext.ledger.tip.encode(),
-                None if tip is None else [tip.slot, tip.block_no, tip.hash],
+                None if tip is None else [tip.slot, tip.block_no, tip.hash,
+                                          int(tip.is_ebb)],
                 [dep.epoch, dep.eta, list(dep.pending)]]
 
     @staticmethod
@@ -184,7 +185,8 @@ class PraosNetworkFactory:
                      for e in obj[0])
         led = MockLedgerState(utxo, int(obj[1]), Point.decode(obj[2]))
         tip = None if obj[3] is None else AnnTip(
-            int(obj[3][0]), int(obj[3][1]), bytes(obj[3][2]))
+            int(obj[3][0]), int(obj[3][1]), bytes(obj[3][2]),
+            bool(obj[3][3]) if len(obj[3]) > 3 else False)
         dep = PraosState(int(obj[4][0]), bytes(obj[4][1]),
                          tuple(bytes(p) for p in obj[4][2]))
         return ExtLedgerState(led, HeaderState(tip, dep))
