@@ -56,7 +56,7 @@ class HeaderState:
 
 
 def validate_envelope(header: Any, header_state: HeaderState,
-                      protocol: Optional[ConsensusProtocol] = None) -> None:
+                      protocol: ConsensusProtocol) -> None:
     """The cheap structural checks (HeaderValidation.hs:278-349):
     block number increments, slot strictly increases, prev hash links.
 
@@ -68,8 +68,7 @@ def validate_envelope(header: Any, header_state: HeaderState,
     (minimumNextSlotNo)."""
     tip = header_state.tip
     is_ebb = _is_ebb(header)
-    if is_ebb and protocol is not None \
-            and not getattr(protocol, "accepts_ebb", False):
+    if is_ebb and not getattr(protocol, "accepts_ebb", False):
         raise HeaderEnvelopeError(
             "EBB header in an era whose protocol admits no EBBs")
     if tip is None:

@@ -277,6 +277,8 @@ class TPraos(ConsensusProtocol):
     def sequential_checks(self, ticked: TPraosState, header,
                           ledger_view: TPraosLedgerView) -> None:
         cfg = self.config
+        # defense-in-depth: validate_envelope / the HFC era gate reject this
+        # first on every production path; kept so TPraos is safe standalone
         if header.get("ebb"):
             raise ProtocolError("TPraos: Shelley admits no EBBs")
         issuer_vk, ocert, pi_eta, pi_leader, _ = self._decode_header(header)

@@ -35,35 +35,61 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def load_db(db_dir: str):
     from ouroboros_tpu.consensus.headers import ProtocolBlock
     from ouroboros_tpu.consensus.ledger import ExtLedgerRules
-    from ouroboros_tpu.consensus.protocols.praos import (
-        Praos, PraosConfig, PraosNode,
-    )
-    from ouroboros_tpu.ledgers.mock import MockLedger, Tx
     from ouroboros_tpu.storage.fs import IoFS
     from ouroboros_tpu.storage.immutabledb import ImmutableDB
     from ouroboros_tpu.utils import cbor
 
     with open(os.path.join(db_dir, "config.json")) as fh:
         cfg = json.load(fh)
-    assert cfg["protocol"] == "mock-praos", cfg["protocol"]
-    protocol = Praos(PraosConfig(
-        nodes=tuple(PraosNode(bytes.fromhex(nd["vrf_vk"]),
-                              bytes.fromhex(nd["kes_vk"]), nd["stake"])
-                    for nd in cfg["nodes"]),
-        k=cfg["k"], f=cfg["f"], epoch_length=cfg["epoch_length"],
-        kes_depth=cfg["kes_depth"],
-        slots_per_kes_period=cfg["slots_per_kes_period"]))
-    ledger = MockLedger({bytes.fromhex(vk): amt
-                         for vk, amt in cfg["genesis"].items()})
+
+    if cfg["protocol"] == "mock-praos":
+        from ouroboros_tpu.consensus.protocols.praos import (
+            Praos, PraosConfig, PraosNode,
+        )
+        from ouroboros_tpu.ledgers.mock import MockLedger, Tx
+        protocol = Praos(PraosConfig(
+            nodes=tuple(PraosNode(bytes.fromhex(nd["vrf_vk"]),
+                                  bytes.fromhex(nd["kes_vk"]), nd["stake"])
+                        for nd in cfg["nodes"]),
+            k=cfg["k"], f=cfg["f"], epoch_length=cfg["epoch_length"],
+            kes_depth=cfg["kes_depth"],
+            slots_per_kes_period=cfg["slots_per_kes_period"]))
+        ledger = MockLedger({bytes.fromhex(vk): amt
+                             for vk, amt in cfg["genesis"].items()})
+        tx_decode = Tx.decode
+    elif cfg["protocol"] == "shelley":
+        from fractions import Fraction
+
+        from ouroboros_tpu.eras.shelley import (
+            ShelleyLedger, ShelleyTx, TPraos, TPraosConfig,
+        )
+        tcfg = TPraosConfig(
+            k=cfg["k"], f=Fraction(cfg["f"]),
+            epoch_length=cfg["epoch_length"],
+            slots_per_kes_period=cfg["slots_per_kes_period"],
+            kes_depth=cfg["kes_depth"],
+            max_kes_evolutions=cfg["max_kes_evolutions"])
+        protocol = TPraos(tcfg, cfg["genesis_seed"].encode())
+        pools = {bytes.fromhex(p["pool_id"]): bytes.fromhex(p["vrf_vk"])
+                 for p in cfg["pools"]}
+        delegs = {bytes.fromhex(p["addr"]): bytes.fromhex(p["pool_id"])
+                  for p in cfg["pools"]}
+        ledger = ShelleyLedger(
+            {bytes.fromhex(a): amt for a, amt in cfg["genesis"].items()},
+            tcfg, pools, delegs)
+        tx_decode = ShelleyTx.decode
+    else:
+        raise SystemExit(f"unknown protocol {cfg['protocol']!r}")
+
     rules = ExtLedgerRules(protocol, ledger)
     fs = IoFS(db_dir)
     db = ImmutableDB.open(fs, cfg.get("chunk_size", 100),
                           validate_all=False)
 
     def decode(raw: bytes) -> ProtocolBlock:
-        return ProtocolBlock.decode(cbor.loads(raw), tx_decode=Tx.decode)
+        return ProtocolBlock.decode(cbor.loads(raw), tx_decode=tx_decode)
 
-    return db, rules, decode
+    return db, rules, decode, cfg
 
 
 def make_backend(name: str):
@@ -110,8 +136,12 @@ def analysis_show_header_size(db, decode, out):
     out.write(f"# max header size {biggest[0]} at slot {biggest[1]}\n")
 
 
+# proofs per header: mock-praos = VRF + KES; shelley = 2 VRF + KES + OCert
+HEADER_PROOFS = {"mock-praos": 2, "shelley": 4}
+
+
 def analysis_validate(db, rules, decode, backend_name: str, mode: str,
-                      window: int, out):
+                      window: int, out, hdr_proofs: int = 2):
     from ouroboros_tpu.consensus.batch import validate_blocks_batched
 
     backend = make_backend(backend_name) if mode == "full" else None
@@ -122,7 +152,7 @@ def analysis_validate(db, rules, decode, backend_name: str, mode: str,
     for entry, raw in db.stream():
         b = decode(raw)
         blocks += 1
-        proofs += 2 + sum(len(tx.witnesses) for tx in b.body)
+        proofs += hdr_proofs + sum(len(tx.witnesses) for tx in b.body)
         if mode == "reapply":
             ext = rules.tick_then_reapply(ext, b)
             continue
@@ -170,7 +200,7 @@ def main() -> None:
                     help="blocks per device batch (full validation)")
     args = ap.parse_args()
 
-    db, rules, decode = load_db(args.db)
+    db, rules, decode, cfg = load_db(args.db)
     out = sys.stdout
     if args.analysis == "show-slot-block-no":
         analysis_show_slot_block_no(db, decode, out)
@@ -180,7 +210,8 @@ def main() -> None:
         analysis_show_header_size(db, decode, out)
     else:
         analysis_validate(db, rules, decode, args.backend, args.validate,
-                          args.window, out)
+                          args.window, out,
+                          hdr_proofs=HEADER_PROOFS.get(cfg["protocol"], 2))
 
 
 if __name__ == "__main__":

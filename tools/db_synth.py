@@ -1,15 +1,21 @@
 #!/usr/bin/env python
-"""db-synth — forge an on-disk mock-Praos chain to replay with db-analyser.
+"""db-synth — forge an on-disk chain to replay with db-analyser.
 
 The role the reference's `db-converter` plays for its validate-mainnet CI
 gate (ouroboros-consensus-byron `db-converter`,
 ouroboros-consensus-byron/ouroboros-consensus-byron.cabal:82 +
 .buildkite/validate-mainnet.sh): produce an ImmutableDB the analyser can
-replay.  The chain carries the full Shelley-shaped proof mix — one ECVRF
-proof + one KES signature per header, Ed25519 tx witnesses per body
-(BASELINE.md configs #2-#4).
+replay.
 
-Usage: python tools/db_synth.py --out DIR [--blocks N] [--txs-per-block M]
+Two chain flavours:
+  --protocol mock-praos   mock ledger + mock-Praos (1 VRF + 1 KES/header)
+  --protocol shelley      TPraos + Shelley ledger — the BASELINE workload:
+                          2 ECVRF proofs + 1 KES sig + 1 OCert Ed25519 sig
+                          per header, Ed25519 tx witnesses per body
+                          (BASELINE.md configs #2-#4).
+
+Usage: python tools/db_synth.py --out DIR [--protocol shelley] [--blocks N]
+       [--txs-per-block M] [--pools P] [--f NUM/DEN]
 """
 from __future__ import annotations
 
@@ -19,23 +25,12 @@ import json
 import os
 import sys
 import time
+from fractions import Fraction
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--out", required=True, help="target directory")
-    ap.add_argument("--blocks", type=int, default=1000)
-    ap.add_argument("--txs-per-block", type=int, default=2)
-    ap.add_argument("--nodes", type=int, default=4)
-    ap.add_argument("--f", type=float, default=0.8)
-    ap.add_argument("--epoch-length", type=int, default=500)
-    ap.add_argument("--kes-depth", type=int, default=10)
-    ap.add_argument("--chunk-size", type=int, default=100)
-    ap.add_argument("--seed", default="db-synth")
-    args = ap.parse_args()
-
+def synth_mock_praos(args) -> dict:
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey,
     )
@@ -66,7 +61,7 @@ def main() -> None:
 
     cfg = PraosConfig(
         nodes=tuple(PraosNode(vrf_vks[i], kes_vks[i], 1) for i in range(n)),
-        k=2160, f=args.f, epoch_length=args.epoch_length,
+        k=2160, f=float(Fraction(args.f)), epoch_length=args.epoch_length,
         kes_depth=args.kes_depth,
         slots_per_kes_period=max(
             1, (args.blocks * 4) // kes_mod.total_periods(args.kes_depth)))
@@ -142,10 +137,142 @@ def main() -> None:
             print(f"  forged {forged}/{args.blocks} "
                   f"({forged / (time.time() - t0):.0f} blocks/s)",
                   file=sys.stderr)
+    return {"blocks": forged, "last_slot": slot - 1}
 
-    print(json.dumps({"blocks": forged, "last_slot": slot - 1,
-                      "dir": args.out,
-                      "synth_secs": round(time.time() - t0, 2)}))
+
+def synth_shelley(args) -> dict:
+    """Forge a TPraos/Shelley chain: the flagship replay workload.
+
+    Reference: the Shelley chain the db-analyser validate-mainnet path
+    replays (tools/db-analyser/Block/Shelley.hs + Shelley/Protocol.hs:
+    433-442 PRTCL verifies per header; Ledger.hs:279-284 witnesses per
+    body)."""
+    from ouroboros_tpu.consensus.headers import ProtocolBlock, make_header
+    from ouroboros_tpu.consensus.ledger import ExtLedgerRules
+    from ouroboros_tpu.crypto import kes as kes_mod
+    from ouroboros_tpu.eras.shelley import (
+        TPraosConfig, forge_tpraos_fields, make_shelley_tx,
+        shelley_genesis_setup,
+    )
+    from ouroboros_tpu.storage.fs import IoFS
+    from ouroboros_tpu.storage.immutabledb import ImmutableDB
+
+    f = Fraction(args.f)
+    # KES periods must cover the whole chain
+    slots_per_period = max(
+        1, int(args.blocks * 2 / f)
+        // kes_mod.total_periods(args.kes_depth) + 1)
+    cfg = TPraosConfig(
+        k=2160, f=f, epoch_length=args.epoch_length,
+        slots_per_kes_period=slots_per_period,
+        kes_depth=args.kes_depth,
+        max_kes_evolutions=kes_mod.total_periods(args.kes_depth) - 2)
+    protocol, ledger, pools = shelley_genesis_setup(
+        args.pools, cfg, stake_per_pool=100_000,
+        seed=args.seed.encode())
+
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "config.json"), "w") as fh:
+        json.dump({
+            "protocol": "shelley",
+            "k": cfg.k, "f": str(f), "epoch_length": cfg.epoch_length,
+            "slots_per_kes_period": cfg.slots_per_kes_period,
+            "kes_depth": cfg.kes_depth,
+            "max_kes_evolutions": cfg.max_kes_evolutions,
+            "genesis_seed": "shelley-genesis",
+            "genesis": {p["addr"].hex(): 100_000 for p in pools},
+            "pools": [{"pool_id": p["keys"].pool_id.hex(),
+                       "vrf_vk": p["keys"].vrf_vk.hex(),
+                       "addr": p["addr"].hex()} for p in pools],
+            "chunk_size": args.chunk_size,
+        }, fh, indent=2)
+
+    fs = IoFS(args.out)
+    db = ImmutableDB.open(fs, args.chunk_size, validate_all=False)
+
+    ext = ExtLedgerRules(protocol, ledger)
+    state = ext.initial_state()
+    # spendable (txid, ix, amount) per pool owner, from the genesis pseudo-tx
+    GEN = ledger.GENESIS_TXID
+    gen_order = sorted(p["addr"] for p in pools)
+    spendable = {i: [(GEN, gen_order.index(p["addr"]), 100_000)]
+                 for i, p in enumerate(pools)}
+
+    prev = None
+    slot = 0
+    forged = 0
+    t0 = time.time()
+    while forged < args.blocks:
+        view = ledger.forecast_view(state.ledger, slot)
+        ticked = protocol.tick_chain_dep_state(
+            state.header.chain_dep_state, view, slot)
+        lead = None
+        for pi, p in enumerate(pools):
+            lead = protocol.check_is_leader(p["can_be_leader"], slot,
+                                            ticked, view)
+            if lead is not None:
+                leader_ix = pi
+                break
+        if lead is None:
+            slot += 1
+            continue
+        p = pools[leader_ix]
+        body = []
+        for t in range(args.txs_per_block):
+            owner = (forged * args.txs_per_block + t) % len(pools)
+            if not spendable[owner]:
+                continue
+            txid, ix, amount = spendable[owner].pop(0)
+            op = pools[owner]
+            tx = make_shelley_tx(
+                inputs=[(txid, ix)], outputs=[(op["addr"], amount)],
+                certs=[], signing_keys=[op["keys"].addr_sk])
+            spendable[owner].append((tx.txid, 0, amount))
+            body.append(tx)
+        hdr = make_header(prev, slot, body, issuer=0)
+        signed = forge_tpraos_fields(protocol, p["hot_key"],
+                                     p["can_be_leader"], lead, hdr)
+        block = ProtocolBlock(signed, tuple(body))
+        db.append_block(block.slot, block.block_no, block.hash,
+                        block.prev_hash, block.bytes)
+        state = ext.tick_then_reapply(state, block)
+        prev = signed
+        forged += 1
+        slot += 1
+        if forged % 500 == 0:
+            print(f"  forged {forged}/{args.blocks} "
+                  f"({forged / (time.time() - t0):.0f} blocks/s)",
+                  file=sys.stderr)
+    return {"blocks": forged, "last_slot": slot - 1}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", required=True, help="target directory")
+    ap.add_argument("--protocol", default="mock-praos",
+                    choices=["mock-praos", "shelley"])
+    ap.add_argument("--blocks", type=int, default=1000)
+    ap.add_argument("--txs-per-block", type=int, default=2)
+    ap.add_argument("--nodes", type=int, default=4,
+                    help="mock-praos forgers")
+    ap.add_argument("--pools", type=int, default=2,
+                    help="shelley stake pools")
+    ap.add_argument("--f", default="4/5",
+                    help="active slot coefficient (fraction)")
+    ap.add_argument("--epoch-length", type=int, default=500)
+    ap.add_argument("--kes-depth", type=int, default=10)
+    ap.add_argument("--chunk-size", type=int, default=100)
+    ap.add_argument("--seed", default="db-synth")
+    args = ap.parse_args()
+
+    t0 = time.time()
+    if args.protocol == "shelley":
+        info = synth_shelley(args)
+    else:
+        info = synth_mock_praos(args)
+    info.update({"protocol": args.protocol, "dir": args.out,
+                 "synth_secs": round(time.time() - t0, 2)})
+    print(json.dumps(info))
 
 
 if __name__ == "__main__":
