@@ -25,7 +25,9 @@ from ..crypto.backend import CryptoBackend, default_backend
 from .header_validation import (
     HeaderError, HeaderState, validate_envelope, revalidate_header,
 )
-from .ledger import ExtLedgerRules, ExtLedgerState, LedgerError
+from .ledger import (
+    ExtLedgerRules, ExtLedgerState, LedgerError, OutsideForecastRange,
+)
 from .protocol import ConsensusProtocol, _verify_mixed
 
 
@@ -62,6 +64,7 @@ def validate_headers_batched(
     header i (from forecasts during sync, or the tip view during replay).
     """
     backend = backend or default_backend()
+    protocol.prefetch_window(headers, backend)
     states: list[HeaderState] = []
     proofs: list = []
     owner: list[int] = []          # proofs[j] belongs to headers[owner[j]]
@@ -70,14 +73,19 @@ def validate_headers_batched(
 
     st = header_state
     for i, h in enumerate(headers):
-        view = ledger_view_for(i, h)
         try:
+            view = ledger_view_for(i, h)
             validate_envelope(h, st, protocol)
             ticked = protocol.tick_chain_dep_state(
                 st.chain_dep_state, view, h.slot)
             protocol.sequential_checks(ticked, h, view)
             reqs = protocol.extract_proofs(ticked, h, view)
             st = revalidate_header(protocol, view, h, st)
+        except OutsideForecastRange as e:
+            # not a validation failure: the caller must wait for the chain
+            # to advance (ChainSync forecast-horizon waiting)
+            seq_error = e
+            break
         except Exception as e:
             seq_error = e if isinstance(e, HeaderError) else HeaderError(str(e))
             break
@@ -103,6 +111,26 @@ def validate_headers_batched(
     return BatchValidationResult(states[:first_bad], first_bad, err)
 
 
+def _seq_block_step(protocol: ConsensusProtocol, ledger, st: ExtLedgerState,
+                    b: Any) -> tuple[list, ExtLedgerState]:
+    """One block of the sequential pass: envelope + cheap checks + proof
+    extraction + optimistic reapply.  Shared by the synchronous and the
+    pipelined drivers.  Raises on any sequential failure."""
+    header = getattr(b, "header", b)
+    view = ledger.forecast_view(st.ledger, header.slot)
+    validate_envelope(header, st.header, protocol)
+    ticked_dep = protocol.tick_chain_dep_state(
+        st.header.chain_dep_state, view, header.slot)
+    protocol.sequential_checks(ticked_dep, header, view)
+    ticked_ledger = ledger.tick(st.ledger, b.slot)
+    ledger.sequential_checks(ticked_ledger, b)
+    reqs = (protocol.extract_proofs(ticked_dep, header, view)
+            + ledger.extract_proofs(ticked_ledger, b))
+    return reqs, ExtLedgerState(
+        ledger.reapply_block(ticked_ledger, b),
+        revalidate_header(protocol, view, header, st.header))
+
+
 def validate_blocks_batched(
         ext_rules: ExtLedgerRules,
         blocks: Sequence[Any],
@@ -114,6 +142,8 @@ def validate_blocks_batched(
     batched."""
     backend = backend or default_backend()
     protocol, ledger = ext_rules.protocol, ext_rules.ledger
+    protocol.prefetch_window([getattr(b, "header", b) for b in blocks],
+                             backend)
     states: list[ExtLedgerState] = []
     proofs: list = []
     owner: list[int] = []
@@ -122,20 +152,8 @@ def validate_blocks_batched(
 
     st = ext_state
     for i, b in enumerate(blocks):
-        header = getattr(b, "header", b)
-        view = ledger.ledger_view(st.ledger)
         try:
-            validate_envelope(header, st.header, protocol)
-            ticked_dep = protocol.tick_chain_dep_state(
-                st.header.chain_dep_state, view, header.slot)
-            protocol.sequential_checks(ticked_dep, header, view)
-            ticked_ledger = ledger.tick(st.ledger, b.slot)
-            ledger.sequential_checks(ticked_ledger, b)
-            reqs = (protocol.extract_proofs(ticked_dep, header, view)
-                    + ledger.extract_proofs(ticked_ledger, b))
-            st = ExtLedgerState(
-                ledger.reapply_block(ticked_ledger, b),
-                revalidate_header(protocol, view, header, st.header))
+            reqs, st = _seq_block_step(protocol, ledger, st, b)
         except Exception as e:
             seq_error = (e if isinstance(e, (HeaderError, LedgerError))
                          else LedgerError(str(e)))
@@ -159,3 +177,155 @@ def validate_blocks_batched(
     else:
         err = seq_error
     return BatchValidationResult(states[:first_bad], first_bad, err)
+
+
+@dataclass
+class ReplayResult:
+    """Outcome of a pipelined replay: final state only (a mainnet-scale
+    replay cannot keep per-block states), global valid-block count, first
+    error."""
+    final_state: Any
+    n_valid: int
+    error: Optional[Exception]
+
+    @property
+    def all_valid(self) -> bool:
+        return self.error is None
+
+
+def replay_blocks_pipelined(
+        ext_rules: ExtLedgerRules,
+        blocks,
+        ext_state: ExtLedgerState,
+        backend: Optional[CryptoBackend] = None,
+        window: int = 512) -> ReplayResult:
+    """Software-pipelined replay: while the device verifies window w's
+    proof batch, the host already runs window w+1's sequential pass — and
+    window w's device call ALSO computes the VRF betas window w+2's
+    sequential pass will need (backend.submit_window), so the latency-
+    bound host<->device link is crossed once per window, overlapped with
+    host work.  (Two windows ahead because window w's results are only
+    fetched after window w+1's sequential pass has begun.)
+
+    `blocks` may be any iterable — windows are consumed with a bounded
+    look-ahead, so a mainnet-scale replay streams without buffering the
+    chain.
+
+    The sequential pass advances optimistically via reapply (no crypto);
+    if a window's proof batch later fails, the replay aborts with the
+    failing block's global index — the db-analyser/LgrDB replay semantics
+    (OnDisk.hs:277), where any invalid block invalidates the run.
+
+    Falls back to the synchronous windowed driver on backends without
+    submit_window."""
+    import itertools
+
+    backend = backend or default_backend()
+    protocol, ledger = ext_rules.protocol, ext_rules.ledger
+    submit = getattr(backend, "submit_window", None)
+    block_iter = iter(blocks)
+
+    def next_window():
+        w = list(itertools.islice(block_iter, window))
+        return w or None
+
+    if submit is None:
+        st = ext_state
+        done = 0
+        while True:
+            w = next_window()
+            if w is None:
+                break
+            res = validate_blocks_batched(ext_rules, w, st,
+                                          backend=backend)
+            done += res.n_valid
+            if not res.all_valid:
+                return ReplayResult(None, done, res.error)
+            st = res.final_state
+        return ReplayResult(st, done, None)
+
+    from ..crypto.backend import GLOBAL_BETA_CACHE
+    # bounded look-ahead: ahead[0] = current window, ahead[1:] = the two
+    # windows whose beta proofs may already be in flight
+    ahead: list = []
+    for _ in range(3):
+        w = next_window()
+        if w is None:
+            break
+        ahead.append(([getattr(b, "header", b) for b in w], w))
+    if ahead:
+        # windows 0 and 1 ride a plain prefetch; window w's device call
+        # then carries window w+2's betas
+        protocol.prefetch_window(
+            [h for hs, _w in ahead[:2] for h in hs], backend)
+
+    st = ext_state
+    pending = None                     # (start_index, submit state)
+    done = 0
+
+    def drain(pending):
+        """Finish a window's device call.  Returns (error, n_valid):
+        error None when every proof held, else the global index of the
+        first bad block is start + first_bad."""
+        start, sub, reqs, owner, n_seq_w = pending
+        ok, betas = backend.finish_window(sub)
+        if betas:
+            GLOBAL_BETA_CACHE.store_many(betas.keys(), betas.values())
+        first_bad, bad = n_seq_w, None
+        for j, good in enumerate(ok):
+            if not good and owner[j] < first_bad:
+                first_bad, bad = owner[j], j
+        if bad is not None:
+            return LedgerError(
+                f"proof {type(reqs[bad]).__name__} failed for block "
+                f"{start + first_bad}"), start + first_bad
+        return None, start + n_seq_w
+
+    while ahead:
+        headers_w, blk_window = ahead.pop(0)
+        nxt = next_window()
+        if nxt is not None:
+            ahead.append(([getattr(b, "header", b) for b in nxt], nxt))
+        reqs: list = []
+        owner: list[int] = []
+        seq_error: Optional[Exception] = None
+        n_seq_w = 0
+        for i, b in enumerate(blk_window):
+            try:
+                rs, st = _seq_block_step(protocol, ledger, st, b)
+            except Exception as e:
+                seq_error = (e if isinstance(e, (HeaderError, LedgerError))
+                             else LedgerError(str(e)))
+                break
+            reqs.extend(rs)
+            owner.extend([i] * len(rs))
+            n_seq_w += 1
+
+        # carry betas for the window TWO ahead (ahead[1] after the pop):
+        # they are fetched at drain time, which precedes that window's
+        # sequential pass
+        next_proofs = (protocol.vrf_proofs_of(ahead[1][0])
+                       if len(ahead) > 1 and seq_error is None else ())
+        next_proofs = [p for p in next_proofs
+                       if p not in GLOBAL_BETA_CACHE]
+        sub = submit(reqs, next_proofs)
+        if pending is not None:
+            err, n_ok = drain(pending)
+            if err is not None:
+                # the earlier window already failed; its index wins
+                backend.finish_window(sub)
+                return ReplayResult(None, n_ok, err)
+        done_before = done
+        done += n_seq_w
+        pending = (done_before, sub, reqs, owner, n_seq_w)
+        if seq_error is not None:
+            err, n_ok = drain(pending)
+            if err is not None:
+                return ReplayResult(None, n_ok, err)
+            return ReplayResult(None, done, seq_error)
+
+    if pending is not None:
+        err, n_ok = drain(pending)
+        if err is not None:
+            return ReplayResult(None, n_ok, err)
+    return ReplayResult(st, done, None)

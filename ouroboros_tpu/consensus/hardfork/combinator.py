@@ -51,6 +51,17 @@ class HardForkState:
     inner: Any
     transitions: tuple = ()          # transitions[i] = epoch era i ended at
 
+    def state_hash(self) -> bytes:
+        """Era-tagged digest over the inner ledger state (for replay-parity
+        checks across backends)."""
+        import hashlib
+
+        from ...utils import cbor
+        return hashlib.blake2b(
+            cbor.dumps([self.era, list(self.transitions),
+                        self.inner.state_hash()]),
+            digest_size=32).digest()
+
 
 @dataclass(frozen=True)
 class HardForkLedgerView:
@@ -151,15 +162,42 @@ class HardForkLedger(LedgerRules):
     def apply_tx(self, state: HardForkState, tx, backend=None
                  ) -> HardForkState:
         """Mempool injection (Combinator/InjectTxs.hs): txs apply in the
-        current era."""
-        inner = self.eras[state.era].ledger.apply_tx(state.inner, tx,
-                                                     backend=backend)
+        current era.  A tx of an earlier era that survives in a mempool
+        across the boundary is rejected as a LedgerError (the reference
+        translates txs when possible; our tx types do not cross), so
+        mempool revalidation drops it instead of crashing."""
+        era = self.eras[state.era]
+        try:
+            inner = era.ledger.apply_tx(state.inner, tx, backend=backend)
+        except LedgerError:
+            raise
+        except Exception as e:
+            raise LedgerError(
+                f"tx not applicable in era {era.name}: {e}") from e
         return replace(state, inner=inner)
 
     def ledger_view(self, state: HardForkState) -> HardForkLedgerView:
         inner_view = self.eras[state.era].ledger.ledger_view(state.inner)
         return HardForkLedgerView(state.era, inner_view,
                                   self.summary(state))
+
+    def forecast_view(self, state: HardForkState,
+                      slot: int) -> HardForkLedgerView:
+        """Cross-era forecasting (Combinator/Ledger.hs): when `slot` lands
+        past a decided transition, tick (translating state across the
+        boundary) and produce the NEW era's view — the view a header of
+        that era validates against."""
+        target = era_of_slot(self.eras, state, state.inner, slot)
+        if target == state.era:
+            inner_view = self.eras[state.era].ledger.forecast_view(
+                state.inner, slot)
+            return HardForkLedgerView(state.era, inner_view,
+                                      self.summary(state))
+        crossed = self.tick(state, slot)
+        inner_view = self.eras[crossed.era].ledger.ledger_view(
+            crossed.inner)
+        return HardForkLedgerView(crossed.era, inner_view,
+                                  self.summary(crossed))
 
 
 class HardForkProtocol(ConsensusProtocol):
@@ -223,6 +261,19 @@ class HardForkProtocol(ConsensusProtocol):
                        ledger_view: HardForkLedgerView) -> list:
         return self.eras[ticked.era].protocol.extract_proofs(
             ticked.inner, header, ledger_view.inner)
+
+    def vrf_proofs_of(self, headers) -> list:
+        """Collect VRF proofs per era tag (betas land in the shared
+        process-wide cache, so a flat list suffices)."""
+        by_era: dict = {}
+        for h in headers:
+            tag = h.get(ERA_FIELD)
+            if isinstance(tag, int) and 0 <= tag < len(self.eras):
+                by_era.setdefault(tag, []).append(h)
+        proofs: list = []
+        for tag, hs in by_era.items():
+            proofs.extend(self.eras[tag].protocol.vrf_proofs_of(hs))
+        return proofs
 
     def reupdate_chain_dep_state(self, ticked: HardForkState, header,
                                  ledger_view: HardForkLedgerView

@@ -100,9 +100,12 @@ class ExtLedgerRules:
 
     def tick_then_apply(self, ext: ExtLedgerState, block: Any,
                         backend=None) -> ExtLedgerState:
-        """Full validation: header crypto + ledger rules (ApplyVal path)."""
+        """Full validation: header crypto + ledger rules (ApplyVal path).
+        The header validates against the view forecast AT ITS SLOT — for
+        era-composed ledgers this is the cross-era view when the block
+        sits past a transition."""
         ticked_ledger = self.ledger.tick(ext.ledger, block.slot)
-        view = self.ledger.ledger_view(ext.ledger)
+        view = self.ledger.forecast_view(ext.ledger, block.slot)
         header = getattr(block, "header", block)
         new_header = validate_header(self.protocol, view, header, ext.header,
                                      backend=backend)
@@ -114,7 +117,7 @@ class ExtLedgerRules:
                           block: Any) -> ExtLedgerState:
         """Known-valid block: no crypto (ReapplyVal path; used for replay)."""
         ticked_ledger = self.ledger.tick(ext.ledger, block.slot)
-        view = self.ledger.ledger_view(ext.ledger)
+        view = self.ledger.forecast_view(ext.ledger, block.slot)
         header = getattr(block, "header", block)
         new_header = revalidate_header(self.protocol, view, header,
                                        ext.header)

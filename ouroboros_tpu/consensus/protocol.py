@@ -95,6 +95,23 @@ class ConsensusProtocol:
         """
         return []
 
+    def vrf_proofs_of(self, headers: Sequence[Any]) -> list:
+        """VRF proofs whose outputs (betas) the sequential pass will need
+        for these headers.  Drives both prefetch_window and the pipelined
+        replay driver (which computes window w+1's betas inside window w's
+        device call)."""
+        return []
+
+    def prefetch_window(self, headers: Sequence[Any],
+                        backend: CryptoBackend) -> None:
+        """Hook run by the batch driver before the sequential pass of a
+        window: batch-compute the headers' VRF betas in one device call
+        instead of per-header host EC math during the fold."""
+        from ..crypto.backend import GLOBAL_BETA_CACHE
+        proofs = self.vrf_proofs_of(headers)
+        if proofs:
+            GLOBAL_BETA_CACHE.prefetch(proofs, backend)
+
     # -- leadership -----------------------------------------------------------
     def check_is_leader(self, can_be_leader: Any, slot: int, ticked: Any,
                         ledger_view: Any) -> Optional[Any]:
@@ -132,24 +149,7 @@ class NullProtocol(ConsensusProtocol):
 
 
 def _verify_mixed(backend: CryptoBackend, reqs: Sequence) -> list[bool]:
-    """Dispatch a mixed list of proof requests to the per-kind batch APIs,
-    preserving order."""
-    from ..crypto.backend import Ed25519Req, VrfReq, KesReq
-    groups: dict[type, list[tuple[int, Any]]] = {}
-    for i, r in enumerate(reqs):
-        groups.setdefault(type(r), []).append((i, r))
-    out: list[bool] = [False] * len(reqs)
-    for ty, items in groups.items():
-        idxs = [i for i, _ in items]
-        rs = [r for _, r in items]
-        if ty is Ed25519Req:
-            res = backend.verify_ed25519_batch(rs)
-        elif ty is VrfReq:
-            res = backend.verify_vrf_batch(rs)
-        elif ty is KesReq:
-            res = backend.verify_kes_batch(rs)
-        else:
-            raise TypeError(f"unknown proof request type {ty}")
-        for i, ok in zip(idxs, res):
-            out[i] = bool(ok)
-    return out
+    """Dispatch a mixed list of proof requests through the backend's fused
+    mixed-batch path (KES hash-paths reduced to Ed25519 leaves on host, one
+    Ed25519 batch + one VRF batch), preserving order."""
+    return backend.verify_mixed(reqs)

@@ -81,6 +81,8 @@ class Praos(ConsensusProtocol):
     def __init__(self, config: PraosConfig):
         self.config = config
         self.security_param = config.k
+        from ...crypto.backend import GLOBAL_BETA_CACHE
+        self._betas = GLOBAL_BETA_CACHE
 
     # -- epochs ---------------------------------------------------------------
     def epoch_of(self, slot: int) -> int:
@@ -104,8 +106,12 @@ class Praos(ConsensusProtocol):
 
     def reupdate_chain_dep_state(self, ticked: PraosState, header,
                                  ledger_view) -> PraosState:
-        beta = vrf_ref.proof_to_hash(header.get(VRF_FIELD))
+        beta = self._betas.get(header.get(VRF_FIELD))
         return replace(ticked, pending=ticked.pending + (beta[:32],))
+
+    def vrf_proofs_of(self, headers) -> list:
+        proofs = [h.get(VRF_FIELD) for h in headers]
+        return [p for p in proofs if p is not None]
 
     # -- validation -----------------------------------------------------------
     def threshold(self, issuer: int) -> int:
@@ -125,7 +131,7 @@ class Praos(ConsensusProtocol):
         if pi is None or sig is None:
             raise ProtocolError("Praos: header missing VRF proof or KES sig")
         try:
-            beta = vrf_ref.proof_to_hash(pi)
+            beta = self._betas.get(pi)
         except Exception as e:
             raise ProtocolError(f"Praos: malformed VRF proof: {e}") from e
         if _leader_value(beta) >= self.threshold(header.issuer):

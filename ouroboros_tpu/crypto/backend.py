@@ -62,28 +62,139 @@ class CryptoBackend:
         raise NotImplementedError
 
     def verify_kes_batch(self, reqs: Sequence[KesReq]) -> list[bool]:
-        """Default: host hash-path check + ed25519 batch on the leaves."""
-        leaf_reqs: list[Ed25519Req] = []
-        slots: list[Optional[int]] = []
-        for r in reqs:
-            try:
-                sig = kes_mod.KesSig.from_bytes(r.depth, r.sig_bytes)
-            except ValueError:
-                slots.append(None)
-                continue
-            prep = kes_mod.verify_prepare(r.depth, r.vk, r.period, sig)
-            if prep is None:
-                slots.append(None)
-            else:
+        """Default: host hash-path check + ed25519 batch on the leaves
+        (the reduction lives in split_mixed)."""
+        ed_reqs, ed_owner, _v, _vo, n = self.split_mixed(reqs)
+        out = [False] * n
+        if ed_reqs:
+            for i, ok in zip(ed_owner, self.verify_ed25519_batch(ed_reqs)):
+                out[i] = bool(ok)
+        return out
+
+    # -- mixed batches --------------------------------------------------------
+    def split_mixed(self, reqs: Sequence):
+        """Host-side split of a mixed request list: KES requests are reduced
+        to their Ed25519 leaf checks (hash-path verification happens here)
+        and merged into the Ed25519 group, so a mixed window costs ONE
+        Ed25519 batch + ONE VRF batch instead of three calls.
+
+        Returns (ed_reqs, ed_owner, vrf_reqs, vrf_owner, n) where owner maps
+        each grouped request back to its index in `reqs`."""
+        ed_reqs: list = []
+        ed_owner: list[int] = []
+        vrf_reqs: list = []
+        vrf_owner: list[int] = []
+        for i, r in enumerate(reqs):
+            if isinstance(r, Ed25519Req):
+                ed_reqs.append(r)
+                ed_owner.append(i)
+            elif isinstance(r, VrfReq):
+                vrf_reqs.append(r)
+                vrf_owner.append(i)
+            elif isinstance(r, KesReq):
+                try:
+                    sig = kes_mod.KesSig.from_bytes(r.depth, r.sig_bytes)
+                except ValueError:
+                    continue          # stays False
+                prep = kes_mod.verify_prepare(r.depth, r.vk, r.period, sig)
+                if prep is None:
+                    continue
                 leaf_vk, leaf_sig = prep
-                slots.append(len(leaf_reqs))
-                leaf_reqs.append(Ed25519Req(leaf_vk, r.msg, leaf_sig))
-        leaf_ok = self.verify_ed25519_batch(leaf_reqs) if leaf_reqs else []
-        return [False if i is None else leaf_ok[i] for i in slots]
+                ed_reqs.append(Ed25519Req(leaf_vk, r.msg, leaf_sig))
+                ed_owner.append(i)
+            else:
+                raise TypeError(f"unknown proof request type {type(r)}")
+        return ed_reqs, ed_owner, vrf_reqs, vrf_owner, len(reqs)
+
+    def verify_mixed(self, reqs: Sequence) -> list[bool]:
+        """Verify a mixed Ed25519/VRF/KES request list, preserving order."""
+        ed_reqs, ed_owner, vrf_reqs, vrf_owner, n = self.split_mixed(reqs)
+        out = [False] * n
+        for i, ok in zip(ed_owner, self.verify_ed25519_batch(ed_reqs)):
+            out[i] = bool(ok)
+        for i, ok in zip(vrf_owner, self.verify_vrf_batch(vrf_reqs)):
+            out[i] = bool(ok)
+        return out
 
     # VRF outputs (beta) for leader election — host-side, cheap
     def vrf_proof_to_hash(self, proof: bytes) -> bytes:
         return vrf_ref.proof_to_hash(proof)
+
+    def vrf_betas_batch(self, proofs: Sequence[bytes]) -> list:
+        """Batched proof_to_hash; None where the proof does not decode.
+        Device backends override with one kernel call (the seq-pass beta
+        prefetch of consensus/batch.py rides on this)."""
+        out = []
+        for pi in proofs:
+            try:
+                out.append(vrf_ref.proof_to_hash(pi))
+            except ValueError:
+                out.append(None)
+        return out
+
+
+_MISSING = object()
+
+
+class VrfBetaCache:
+    """proof bytes -> beta (proof_to_hash) memo with batched prefetch.
+
+    The sequential pass of window validation needs the VRF output of every
+    header (leader-threshold check, nonce evolution) — per-proof host EC
+    math there costs more than the whole device batch.  Protocols own one
+    of these; the batch driver prefetches a window's proofs in one
+    backend.vrf_betas_batch call before the sequential fold."""
+
+    def __init__(self, max_entries: int = 200_000):
+        self._cache: dict = {}
+        self.max_entries = max_entries
+
+    def __contains__(self, proof: bytes) -> bool:
+        return proof in self._cache
+
+    def get(self, proof: bytes) -> bytes:
+        """Beta for the proof; raises ValueError exactly where
+        vrf_ref.proof_to_hash does."""
+        v = self._cache.get(proof, _MISSING)
+        if v is _MISSING:
+            try:
+                v = vrf_ref.proof_to_hash(proof)
+            except ValueError:
+                v = None
+            self._store(proof, v)
+        if v is None:
+            raise ValueError("invalid proof")
+        return v
+
+    def prefetch(self, proofs: Sequence[bytes],
+                 backend: "CryptoBackend") -> None:
+        todo = [p for p in dict.fromkeys(proofs) if p not in self._cache]
+        if not todo:
+            return
+        for p, b in zip(todo, backend.vrf_betas_batch(todo)):
+            self._store(p, b)
+
+    def _store(self, proof: bytes, beta) -> None:
+        if len(self._cache) >= self.max_entries:
+            # evict the oldest half (insertion order), never the entries
+            # just prefetched for the in-flight window
+            drop = len(self._cache) // 2
+            for k in list(self._cache)[:drop]:
+                del self._cache[k]
+        self._cache[proof] = beta
+
+    def clear(self) -> None:
+        self._cache.clear()
+
+    def store_many(self, proofs: Sequence[bytes], betas: Sequence) -> None:
+        for p, b in zip(proofs, betas):
+            self._store(p, b)
+
+
+# beta = proof_to_hash(proof) is a pure function of the proof bytes, so one
+# process-wide cache serves every protocol instance (TPraos, mock Praos,
+# and the HFC combinator all read it)
+GLOBAL_BETA_CACHE = VrfBetaCache()
 
 
 class CpuRefBackend(CryptoBackend):

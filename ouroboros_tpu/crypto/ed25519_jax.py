@@ -126,12 +126,12 @@ def _sq_n(x, n):
     return lax.fori_loop(0, n, lambda _, v: F.mul(v, v), x)
 
 
-def pow_p58(z):
-    """z^((p-5)/8) = z^(2^252 - 3), ref10 addition chain (~254 sq + 11 mul)."""
-    t0 = F.mul(z, z)                      # 2
-    t1 = F.mul(z, _sq_n(t0, 2))           # 9
-    t0 = F.mul(t0, t1)                    # 11
-    t0 = F.mul(t1, F.mul(t0, t0))         # 31 = 2^5 - 1
+def _chain250(z):
+    """Shared ref10 ladder prefix: returns (z^(2^250-1), z^11, z^2)."""
+    z2 = F.mul(z, z)                      # 2
+    z9 = F.mul(z, _sq_n(z2, 2))           # 9
+    z11 = F.mul(z2, z9)                   # 11
+    t0 = F.mul(z9, F.mul(z11, z11))       # 31 = 2^5 - 1
     t0 = F.mul(_sq_n(t0, 5), t0)          # 2^10 - 1
     t1 = F.mul(_sq_n(t0, 10), t0)         # 2^20 - 1
     t1 = F.mul(_sq_n(t1, 20), t1)         # 2^40 - 1
@@ -139,7 +139,28 @@ def pow_p58(z):
     t1 = F.mul(_sq_n(t0, 50), t0)         # 2^100 - 1
     t1 = F.mul(_sq_n(t1, 100), t1)        # 2^200 - 1
     t0 = F.mul(_sq_n(t1, 50), t0)         # 2^250 - 1
-    return F.mul(_sq_n(t0, 2), z)         # 2^252 - 3
+    return t0, z11, z2
+
+
+def pow_p58(z):
+    """z^((p-5)/8) = z^(2^252 - 3), ref10 addition chain (~254 sq + 11 mul)."""
+    t250, _z11, _z2 = _chain250(z)
+    return F.mul(_sq_n(t250, 2), z)       # 2^252 - 3
+
+
+def pow_inv(z):
+    """z^(p-2) = z^(2^255 - 21): batched field inversion (inv(0) = 0,
+    matching edwards.inv's pow semantics)."""
+    t250, z11, _z2 = _chain250(z)
+    return F.mul(_sq_n(t250, 5), z11)     # 2^255 - 32 + 11
+
+
+def pow_chi(z):
+    """z^((p-1)/2) = z^(2^254 - 10): Legendre symbol (1 / p-1 / 0)."""
+    t250, _z11, z2 = _chain250(z)
+    z4 = F.mul(z2, z2)
+    z6 = F.mul(z4, z2)
+    return F.mul(_sq_n(t250, 4), z6)      # 2^254 - 16 + 6
 
 
 @jax.jit

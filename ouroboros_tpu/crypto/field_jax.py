@@ -61,6 +61,7 @@ def unpack(arr) -> list[int]:
     return list((_UNPACK_WEIGHTS @ a) % P)
 
 
+
 # 2p in limb form, for subtraction without negatives: a - b := a + 2p - b.
 _TWO_P_LIMBS = np.array(int_to_limbs(2 * P), dtype=np.int32)[:, None]
 

@@ -1,24 +1,19 @@
 """JaxBackend — the TPU-batched CryptoBackend instance.
 
-Routes Ed25519 batches through ed25519_jax.verify_kernel and VRF batches
-through dual_scalar_mult_kernel (U and V halves concatenated into one device
-call), with Montgomery batch inversion on host for the final point
-compressions (one modular pow per batch instead of one per point).
+Routes Ed25519 batches through ed25519_jax.verify_full_kernel and VRF
+batches through vrf_jax.vrf_verify_kernel (decompression, Elligator2 and
+both Strauss ladders fused into one device call), with Montgomery batch
+inversion on host for the final point compressions (one modular pow per
+batch instead of one per point).
 
 Batch sizes are padded to power-of-two buckets (min 128) so repeated calls
 hit the jit cache instead of recompiling per shape.
 """
 from __future__ import annotations
 
-import numpy as np
-
-import jax.numpy as jnp
-
 from . import ed25519_jax as EJ
 from . import edwards as ed
-from . import field_jax as F
-from . import vrf_ref
-from .backend import CryptoBackend, CpuRefBackend
+from .backend import CryptoBackend
 
 
 def _bucket(n: int, lo: int = 128) -> int:
@@ -26,6 +21,15 @@ def _bucket(n: int, lo: int = 128) -> int:
     while m < n:
         m *= 2
     return m
+
+
+def _pack_flat(parts):
+    """Concatenate device arrays into one flat uint8 buffer ON DEVICE (an
+    async jnp dispatch, no host transfer) so finish_window fetches a
+    single array across the latency-bound link."""
+    import jax.numpy as jnp
+    flat = [p.reshape(-1) for p in parts]
+    return flat[0] if len(flat) == 1 else jnp.concatenate(flat)
 
 
 def batch_inverse(vals: list[int]) -> list[int]:
@@ -63,76 +67,103 @@ class JaxBackend(CryptoBackend):
     def verify_vrf_batch(self, reqs):
         if not reqs:
             return []
-        n = len(reqs)
-        # host half: decode, hash-to-curve, challenge decode
-        items = []          # (j, s, c, Y, Gamma, H)
-        valid = np.zeros(n, dtype=bool)
-        for j, r in enumerate(reqs):
-            Y = ed.decompress(r.vk) if len(r.vk) == 32 else None
-            decoded = vrf_ref.decode_proof(r.proof)
-            if Y is None or decoded is None:
-                continue
-            Gamma, c, s = decoded
-            H = vrf_ref._hash_to_curve(r.vk, r.alpha)
-            items.append((j, s, c, Y, Gamma, H))
-            valid[j] = True
-        if not items:
-            return [False] * n
-        m = _bucket(2 * len(items), self.min_bucket)
-        # batch layout: [U half | V half | padding]
-        p1, p2, abits, bbits = [], [], [], []
-        for (_, s, c, Y, Gamma, H) in items:
-            p1.append(ed.to_affine(ed.BASE))
-            p2.append(_neg_affine(Y))
-            abits.append(s)
-            bbits.append(c)
-        for (_, s, c, Y, Gamma, H) in items:
-            p1.append(_affine(H))
-            p2.append(_neg_affine(Gamma))
-            abits.append(s)
-            bbits.append(c)
-        pad = m - len(p1)
-        base_aff = ed.to_affine(ed.BASE)
-        p1 += [base_aff] * pad
-        p2 += [base_aff] * pad
-        abits += [1] * pad
-        bbits += [1] * pad
-        arrays = _pack_points(p1) + _pack_points(p2) + (
-            _pack_bits(abits), _pack_bits(bbits))
-        X, Yc, Z = EJ.dual_scalar_mult_kernel(*[jnp.asarray(a)
-                                                for a in arrays])
-        xs = F.unpack(np.asarray(X))
-        ys = F.unpack(np.asarray(Yc))
-        zs = F.unpack(np.asarray(Z))
-        zinv = batch_inverse(zs[:2 * len(items)])
-        out = [False] * n
-        k = len(items)
-        for i, (j, s, c, Y, Gamma, H) in enumerate(items):
-            U = ed.from_affine(xs[i] * zinv[i] % ed.P,
-                               ys[i] * zinv[i] % ed.P)
-            V = ed.from_affine(xs[k + i] * zinv[k + i] % ed.P,
-                               ys[k + i] * zinv[k + i] % ed.P)
-            out[j] = vrf_ref._hash_points(H, Gamma, U, V) == c
-        return out
+        from . import vrf_jax
+        oks, _betas = vrf_jax.batch_verify_vrf(
+            [r.vk for r in reqs], [r.alpha for r in reqs],
+            [r.proof for r in reqs],
+            pad_to=_bucket(len(reqs), self.min_bucket))
+        return oks
+
+    def vrf_betas_batch(self, proofs):
+        from . import vrf_jax
+        return vrf_jax.batch_betas(
+            proofs, pad_to=_bucket(len(proofs), self.min_bucket))
+
+    def submit_window(self, reqs, next_beta_proofs=()):
+        """Dispatch one replay window's whole device workload — the mixed
+        Ed25519/VRF/KES verification of `reqs` AND the VRF betas the NEXT
+        window's sequential pass will need — as async kernel calls whose
+        results are packed on-device into ONE flat uint8 array, so the
+        latency-bound host<->device link is crossed exactly once per
+        window.  Returns an opaque state for finish_window."""
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from . import vrf_jax
+        ed_reqs, ed_owner, vrf_reqs, vrf_owner, n = self.split_mixed(reqs)
+        parts = []
+        ed_state = vrf_state = beta_state = None
+        ne = nv = nb = 0
+        if ed_reqs:
+            ne = _bucket(len(ed_reqs), self.min_bucket)
+            pad = ne - len(ed_reqs)
+            arrays, parse_ok = EJ.prepare_bytes_batch(
+                [r.vk for r in ed_reqs] + [b"\x00" * 32] * pad,
+                [r.msg for r in ed_reqs] + [b""] * pad,
+                [r.sig for r in ed_reqs] + [b"\x00" * 64] * pad)
+            ed_state = (EJ.verify_kernel_full_submit(arrays), parse_ok)
+            parts.append(ed_state[0].astype(jnp.uint8))
+        if vrf_reqs:
+            nv = _bucket(len(vrf_reqs), self.min_bucket)
+            pad = nv - len(vrf_reqs)
+            vrf_state = vrf_jax._submit(
+                [r.vk for r in vrf_reqs] + [b"\x00" * 32] * pad,
+                [r.alpha for r in vrf_reqs] + [b""] * pad,
+                [r.proof for r in vrf_reqs] + [b"\x00" * 80] * pad, nv)
+            parts.append(vrf_state[0].reshape(-1))
+        beta_proofs = list(dict.fromkeys(next_beta_proofs))
+        if beta_proofs:
+            nb = _bucket(len(beta_proofs), self.min_bucket)
+            padded = beta_proofs + [b"\x00" * 80] * (nb - len(beta_proofs))
+            handle, decode_ok = vrf_jax._submit_betas(padded, nb)
+            beta_state = (decode_ok,)
+            parts.append(handle.reshape(-1))
+        packed = _pack_flat(parts) if parts else None
+        return {"packed": packed, "n": n,
+                "ed": ed_state, "ed_owner": ed_owner, "ne": ne,
+                "vrf": vrf_state, "vrf_owner": vrf_owner,
+                "vrf_n": len(vrf_reqs), "nv": nv,
+                "beta": beta_state, "beta_proofs": beta_proofs, "nb": nb}
+
+    def finish_window(self, state):
+        """Block on a submit_window dispatch (one transfer); returns
+        (ok list aligned with the submitted reqs, {proof: beta} for the
+        requested next-window proofs)."""
+        import numpy as np
+        out = [False] * state["n"]
+        betas: dict = {}
+        if state["packed"] is None:
+            return out, betas
+        flat = np.asarray(state["packed"])          # THE round trip
+        off = 0
+        if state["ed"] is not None:
+            ed_ok = flat[off:off + state["ne"]]
+            off += state["ne"]
+            _handle, parse_ok = state["ed"]
+            for k, i in enumerate(state["ed_owner"]):
+                out[i] = bool(ed_ok[k]) and bool(parse_ok[k])
+        if state["vrf"] is not None:
+            rows = flat[off:off + state["nv"] * 130].reshape(-1, 130)
+            off += state["nv"] * 130
+            from . import vrf_jax
+            _h, parse_ok, gamma_ok, s_ok, pf_arr = state["vrf"]
+            oks, _b = vrf_jax._finish(rows, parse_ok, gamma_ok, s_ok,
+                                      pf_arr, state["vrf_n"])
+            for i, ok in zip(state["vrf_owner"], oks):
+                out[i] = ok
+        if state["beta"] is not None:
+            rows = flat[off:off + state["nb"] * 33].reshape(-1, 33)
+            from . import vrf_jax
+            bs = vrf_jax._finish_betas(rows, state["beta"][0],
+                                       len(state["beta_proofs"]))
+            betas = dict(zip(state["beta_proofs"], bs))
+        return out, betas
+
+    def verify_mixed(self, reqs):
+        """Fused mixed batch: one packed device transfer for the whole
+        window (see submit_window)."""
+        ok, _betas = self.finish_window(self.submit_window(reqs))
+        return ok
 
 
-def _affine(p):
-    if p[2] == 1:
-        return p[0], p[1]
-    return ed.to_affine(p)
-
-
-def _neg_affine(p):
-    x, y = _affine(p)
-    return (ed.P - x) % ed.P, y
-
-
-def _pack_points(pts):
-    xs = [p[0] for p in pts]
-    ys = [p[1] for p in pts]
-    ts = [p[0] * p[1] % ed.P for p in pts]
-    return (F.pack(xs), F.pack(ys), F.pack(ts))
-
-
-def _pack_bits(scalars):
-    return np.stack([EJ._bits_msb_first(s) for s in scalars], axis=1)

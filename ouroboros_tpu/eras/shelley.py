@@ -42,7 +42,9 @@ from ..consensus.ledger import LedgerError, LedgerRules, OutsideForecastRange
 from ..consensus.protocol import ConsensusProtocol, ProtocolError
 from ..consensus.protocols.praos import HotKey
 from ..crypto import ed25519_ref, kes as kes_mod, vrf_ref
-from ..crypto.backend import Ed25519Req, KesReq, VrfReq
+from ..crypto.backend import (
+    Ed25519Req, GLOBAL_BETA_CACHE, KesReq, VrfReq,
+)
 from ..utils import cbor
 
 # header protocol-evidence fields (sign-the-header-minus-KES-sig convention)
@@ -227,6 +229,7 @@ class TPraos(ConsensusProtocol):
         self.config = config
         self.genesis_seed = genesis_seed
         self.security_param = config.k
+        self._betas = GLOBAL_BETA_CACHE
 
     # -- epochs / periods ----------------------------------------------------
     def epoch_of(self, slot: int) -> int:
@@ -289,7 +292,7 @@ class TPraos(ConsensusProtocol):
                 f"TPraos: issuer pool {pid.hex()[:12]} not in the stake "
                 f"distribution")
         try:
-            beta_leader = vrf_ref.proof_to_hash(pi_leader)
+            beta_leader = self._betas.get(pi_leader)
         except ValueError as e:
             raise ProtocolError(f"TPraos: malformed leader VRF: {e}") from e
         from .nonintegral import check_leader_value
@@ -343,12 +346,21 @@ class TPraos(ConsensusProtocol):
                    msg=header.bytes_dropping(KES_FIELD), sig_bytes=kes_sig),
         ]
 
+    def vrf_proofs_of(self, headers) -> list:
+        proofs = []
+        for h in headers:
+            for field_name in (ETA_VRF_FIELD, LEADER_VRF_FIELD):
+                pi = h.get(field_name)
+                if pi is not None:
+                    proofs.append(pi)
+        return proofs
+
     def reupdate_chain_dep_state(self, ticked: TPraosState, header,
                                  ledger_view) -> TPraosState:
         """Nonce evolution (UPDN) + ocert counter bookkeeping — the cheap
         sequential pass."""
         issuer_vk, ocert, pi_eta, _, _ = self._decode_header(header)
-        block_nonce = _b2b(vrf_ref.proof_to_hash(pi_eta))
+        block_nonce = _b2b(self._betas.get(pi_eta))
         eta_v = _b2b(ticked.eta_v + block_nonce)
         eta_c = eta_v if header.slot < self._freeze_slot(ticked.epoch) \
             else ticked.eta_c
@@ -383,7 +395,7 @@ class TPraos(ConsensusProtocol):
         return TPraosSelectView(
             block_no=header.block_no, slot=header.slot, issuer_vk=issuer_vk,
             issue_no=ocert.counter,
-            leader_vrf=_leader_value(vrf_ref.proof_to_hash(pi_leader)))
+            leader_vrf=_leader_value(self._betas.get(pi_leader)))
 
     def prefer_candidate(self, ours: TPraosSelectView,
                          candidate: TPraosSelectView) -> bool:

@@ -101,27 +101,53 @@ async def chain_sync_client(session, kernel, candidate: CandidateState,
     buffered: list = []          # validated-pending roll-forward headers
 
     def flush() -> None:
-        """Validate `buffered` as one batched window and publish."""
+        """Validate `buffered` as one batched window and publish.
+
+        Views are forecast at each header's slot (cross-era aware); when
+        the forecast horizon is hit the validated prefix is published and
+        the rest stays buffered until the chain advances (the reference's
+        forecast-horizon waiting, Client.hs:~740-790)."""
         if not buffered:
             return
+        from ouroboros_tpu.consensus.ledger import OutsideForecastRange
         res = validate_headers_batched(
             protocol, buffered, history.current,
-            lambda i, h: kernel.ledger_view(), backend=kernel.backend)
+            lambda i, h: kernel.forecast_view(h.slot),
+            backend=kernel.backend)
         for st, h in zip(res.states, buffered[:res.n_valid]):
             history.append(st)
             fragment.add_block(h)
-        del buffered[:]
+        del buffered[:res.n_valid]
         if res.n_valid:
             candidate.publish(fragment.copy())
-        if res.error is not None:
-            raise ChainSyncClientError(f"invalid header from peer: "
-                                       f"{res.error}")
+        if res.error is None:
+            return
+        if isinstance(res.error, OutsideForecastRange):
+            horizon_stalled[0] = True   # wait: headers stay buffered
+            return
+        del buffered[:]
+        raise ChainSyncClientError(f"invalid header from peer: "
+                                   f"{res.error}")
+
+    horizon_stalled = [False]
 
     # -- pipelined follow loop ------------------------------------------------
     while True:
         while session.outstanding < window:
             await session.send_pipelined(MsgRequestNext(), "StIdle")
-        msg = await session.collect()
+        if horizon_stalled[0] and buffered:
+            # forecast horizon hit: our own chain must advance (BlockFetch
+            # adopting the validated prefix) before the rest validates —
+            # poll with a timeout instead of blocking on the peer, who may
+            # be quiescent at its tip (Client.hs forecast waiting)
+            done, msg = await sim.timeout(0.2, session.collect())
+            if not done:
+                horizon_stalled[0] = False
+                flush()
+                continue
+            horizon_stalled[0] = False
+        else:
+            msg = await session.collect()
         if isinstance(msg, MsgAwaitReply):
             # caught up: validate what we have, then wait for the next
             # server push (the collect below blocks on the channel)

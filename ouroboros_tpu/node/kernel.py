@@ -105,6 +105,12 @@ class NodeKernel:
     def ledger_view(self):
         return self.ledger_rules.ledger_view(self.chain_db.current_ledger.ledger)
 
+    def forecast_view(self, slot: int):
+        """View forecast at `slot` from the current tip (cross-era aware);
+        raises OutsideForecastRange past the stability horizon."""
+        return self.ledger_rules.forecast_view(
+            self.chain_db.current_ledger.ledger, slot)
+
     def have_block(self, h: bytes) -> bool:
         db = self.chain_db
         return db.volatile.block_info(h) is not None or h in db.immutable
@@ -194,7 +200,10 @@ class NodeKernel:
 
     def _try_forge(self, forging: BlockForging, slot: int) -> None:
         ext = self.chain_db.current_ledger
-        view = self.ledger_rules.ledger_view(ext.ledger)
+        # forecast AT the slot (NodeKernel.hs:~400 ledger view forecast):
+        # for era-composed ledgers this is the new era's view when `slot`
+        # sits past a decided transition
+        view = self.ledger_rules.forecast_view(ext.ledger, slot)
         ticked_dep = self.protocol.tick_chain_dep_state(
             ext.header.chain_dep_state, view, slot)
         proof = self.protocol.check_is_leader(

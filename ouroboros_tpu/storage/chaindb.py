@@ -63,7 +63,8 @@ class Follower:
             imm_slot = db.immutable.slot_of_hash(self.point.hash)
             if (self.point.is_genesis and db.immutable.tip is not None) \
                     or (imm_slot is not None and imm_slot == self.point.slot):
-                nxt = db.immutable.next_after(self.point.slot)
+                nxt = db.immutable.next_after_hash(
+                    None if self.point.is_genesis else self.point.hash)
                 if nxt is not None:
                     entry, raw = nxt
                     blk = db.block_decode(raw)
@@ -492,8 +493,10 @@ class ChainDB:
             return 0
         to_copy = list(chain.blocks[:excess])
         for b in to_copy:
+            hdr = getattr(b, "header", b)
+            is_ebb = bool(hdr.get("ebb", 0)) if hasattr(hdr, "get") else False
             self.immutable.append_block(b.slot, b.block_no, b.hash,
-                                        b.prev_hash, b.bytes)
+                                        b.prev_hash, b.bytes, is_ebb=is_ebb)
         new_anchor_blk = to_copy[-1]
         self.current_chain = chain._rebuild(
             point_of(new_anchor_blk), chain.blocks[excess:],

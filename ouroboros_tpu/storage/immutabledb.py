@@ -25,7 +25,11 @@ DIR = ("immutable",)
 
 @dataclass(frozen=True)
 class SecondaryEntry:
-    """One block's index record (Impl/Index/Secondary.hs entry)."""
+    """One block's index record (Impl/Index/Secondary.hs entry).
+
+    is_ebb mirrors the reference's per-entry EBB marker: an epoch-boundary
+    block may SHARE its slot with the following real block (the two
+    relative slots of Chunks/Layout.hs)."""
     offset: int
     size: int
     crc: int
@@ -33,15 +37,25 @@ class SecondaryEntry:
     prev_hash: bytes
     slot: int
     block_no: int
+    is_ebb: int = 0
 
     def encode(self):
         return [self.offset, self.size, self.crc, self.hash, self.prev_hash,
-                self.slot, self.block_no]
+                self.slot, self.block_no, self.is_ebb]
 
     @classmethod
     def decode(cls, obj):
         return cls(int(obj[0]), int(obj[1]), int(obj[2]), bytes(obj[3]),
-                   bytes(obj[4]), int(obj[5]), int(obj[6]))
+                   bytes(obj[4]), int(obj[5]), int(obj[6]),
+                   int(obj[7]) if len(obj) > 7 else 0)
+
+
+def _slot_ok(tip: SecondaryEntry, slot: int, is_ebb: bool) -> bool:
+    """Strictly increasing slots, except the real block following an EBB
+    may share its slot (Chunks/Layout.hs relative-slot pair)."""
+    if slot > tip.slot:
+        return True
+    return slot == tip.slot and bool(tip.is_ebb) and not is_ebb
 
 
 def _chunk_file(n: int) -> tuple:
@@ -63,7 +77,6 @@ class ImmutableDB:
         self._chunks: dict[int, list[SecondaryEntry]] = {}
         self._by_slot: dict[int, tuple] = {}
         self._by_hash: dict[bytes, int] = {}
-        self._slots: list[int] = []          # ascending (append-only)
         self._tip: Optional[SecondaryEntry] = None
 
     # -- open + validation ----------------------------------------------------
@@ -114,7 +127,8 @@ class ImmutableDB:
                 data = fs.read_range(_chunk_file(n), e.offset, e.size)
                 if crc32(data) != e.crc:
                     break
-            if self._tip is not None and e.slot <= self._tip.slot:
+            if self._tip is not None and not _slot_ok(self._tip, e.slot,
+                                                      bool(e.is_ebb)):
                 break                               # non-monotone: corrupt
             keep.append(e)
             self._index(n, e)
@@ -132,9 +146,11 @@ class ImmutableDB:
 
     def _index(self, n: int, e: SecondaryEntry) -> None:
         self._chunks.setdefault(n, []).append(e)
-        self._by_slot[e.slot] = (n, len(self._chunks[n]) - 1)
-        self._by_hash[e.hash] = e.slot
-        self._slots.append(e.slot)
+        loc = (n, len(self._chunks[n]) - 1)
+        # an EBB and its successor share a slot; the real block wins the
+        # slot index (appended second), hashes stay unique
+        self._by_slot[e.slot] = loc
+        self._by_hash[e.hash] = loc
         self._tip = e
 
     # -- queries --------------------------------------------------------------
@@ -157,29 +173,48 @@ class ImmutableDB:
         return self.fs.read_range(_chunk_file(n), e.offset, e.size)
 
     def get_by_hash(self, h: bytes) -> Optional[bytes]:
-        slot = self._by_hash.get(h)
-        return None if slot is None else self.get_by_slot(slot)
+        loc = self._by_hash.get(h)
+        if loc is None:
+            return None
+        n, i = loc
+        e = self._chunks[n][i]
+        return self.fs.read_range(_chunk_file(n), e.offset, e.size)
 
     def slot_of_hash(self, h: bytes) -> Optional[int]:
-        return self._by_hash.get(h)
-
-    def next_after(self, slot: int) -> Optional[tuple[SecondaryEntry, bytes]]:
-        """(entry, bytes) of the block at the smallest slot > `slot` — lets
-        ChainDB followers stream the immutable chain without iterators."""
-        import bisect
-        i = bisect.bisect_right(self._slots, slot)
-        if i >= len(self._slots):
+        loc = self._by_hash.get(h)
+        if loc is None:
             return None
-        s = self._slots[i]
-        n, j = self._by_slot[s]
-        e = self._chunks[n][j]
-        return e, self.fs.read_range(_chunk_file(n), e.offset, e.size)
+        n, i = loc
+        return self._chunks[n][i].slot
+
+    def _entry_at(self, n: int, j: int
+                  ) -> Optional[tuple[SecondaryEntry, bytes]]:
+        while n <= (max(self._chunks) if self._chunks else -1):
+            chunk = self._chunks.get(n, [])
+            if j < len(chunk):
+                e = chunk[j]
+                return e, self.fs.read_range(_chunk_file(n), e.offset,
+                                             e.size)
+            n, j = n + 1, 0
+        return None
+
+    def next_after_hash(self, h: Optional[bytes]
+                        ) -> Optional[tuple[SecondaryEntry, bytes]]:
+        """Chain successor of the block with hash `h` (None/unknown hash =
+        start of the chain) — EBB-safe: walks chunk order, not slots."""
+        if h is None:
+            return self._entry_at(min(self._chunks), 0) if self._chunks \
+                else None
+        loc = self._by_hash.get(h)
+        if loc is None:
+            return None
+        return self._entry_at(loc[0], loc[1] + 1)
 
     def entry_by_hash(self, h: bytes) -> Optional[SecondaryEntry]:
-        slot = self._by_hash.get(h)
-        if slot is None:
+        loc = self._by_hash.get(h)
+        if loc is None:
             return None
-        n, i = self._by_slot[slot]
+        n, i = loc
         return self._chunks[n][i]
 
     def stream(self, from_slot: int = 0,
@@ -199,8 +234,9 @@ class ImmutableDB:
 
     # -- append ---------------------------------------------------------------
     def append_block(self, slot: int, block_no: int, h: bytes,
-                     prev_hash: bytes, data: bytes) -> None:
-        if self._tip is not None and slot <= self._tip.slot:
+                     prev_hash: bytes, data: bytes,
+                     is_ebb: bool = False) -> None:
+        if self._tip is not None and not _slot_ok(self._tip, slot, is_ebb):
             raise ValueError(
                 f"append slot {slot} not after tip slot {self._tip.slot}")
         n = self.chunk_of(slot)
@@ -209,7 +245,7 @@ class ImmutableDB:
         except FsError:
             offset = 0
         e = SecondaryEntry(offset, len(data), crc32(data), h, prev_hash,
-                           slot, block_no)
+                           slot, block_no, int(is_ebb))
         self.fs.append_file(_chunk_file(n), data)
         self.fs.append_file(_secondary_file(n), cbor.dumps(e.encode()))
         self._index(n, e)

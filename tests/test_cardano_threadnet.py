@@ -1,0 +1,203 @@
+"""Cross-era ThreadNet with REAL era protocols: a network of full nodes
+running Byron PBFT crosses the ledger-decided fork into Shelley TPraos
+mid-run.
+
+Reference: ouroboros-consensus-cardano-test/test/Test/ThreadNet/Cardano.hs
+— the crown-jewel cross-era integration test (SURVEY.md §4.1), here over
+eras/cardano.py's composition instead of mock protocols.
+"""
+import pytest
+
+from ouroboros_tpu import simharness as sim
+from ouroboros_tpu.chain.block import Point
+from ouroboros_tpu.consensus.hardfork.combinator import (
+    ERA_FIELD, HardForkState, hfc_forge,
+)
+from ouroboros_tpu.consensus.header_validation import AnnTip, HeaderState
+from ouroboros_tpu.consensus.headers import ProtocolBlock, ProtocolHeader
+from ouroboros_tpu.consensus.ledger import ExtLedgerState
+from ouroboros_tpu.consensus.mempool import Mempool
+from ouroboros_tpu.crypto.backend import OpensslBackend
+from ouroboros_tpu.eras.byron import (
+    CERT_UPDATE, ByronLedgerState, byron_sign_header, make_byron_tx,
+)
+from ouroboros_tpu.eras.cardano import (
+    BYRON, SHELLEY, cardano_block_decode, cardano_setup,
+)
+from ouroboros_tpu.eras.shelley import (
+    ShelleyLedgerState, TPraosState, forge_tpraos_fields,
+)
+from ouroboros_tpu.node import BlockForging, NodeKernel, connect_nodes
+from ouroboros_tpu.node.blockchain_time import HardForkBlockchainTime
+from ouroboros_tpu.storage import MockFS
+from ouroboros_tpu.storage.chaindb import ChainDB
+from ouroboros_tpu.utils import cbor
+
+N_NODES = 3
+EPOCH = 10
+FORK_EPOCH = 2                        # Byron ends at slot 20
+BACKEND = OpensslBackend()
+
+
+def _enc_state(ext):
+    led: HardForkState = ext.ledger
+    dep: HardForkState = ext.header.chain_dep_state
+    if led.era == BYRON:
+        led_inner = [list(e) for e in led.inner.utxo], \
+            list(led.inner.delegates), led.inner.slot, \
+            led.inner.tip.encode(), led.inner.update_epoch
+        led_obj = [BYRON, list(led_inner)]
+    else:
+        s: ShelleyLedgerState = led.inner
+        led_obj = [SHELLEY, [
+            [[t, i, a, m, [list(av) for av in assets]]
+             for t, i, a, m, assets in s.utxo],
+            [[a, p] for a, p in s.delegs],
+            [[p, v] for p, v in s.pools],
+            s.epoch,
+            [[p, st, v] for p, st, v in s.snap_mark],
+            [[p, st, v] for p, st, v in s.snap_set],
+            s.slot, s.tip.encode()]]
+    if dep.era == BYRON:
+        dep_obj = [BYRON, list(dep.inner)]
+    else:
+        t: TPraosState = dep.inner
+        dep_obj = [SHELLEY, [t.epoch, t.eta0, t.eta_v, t.eta_c,
+                             [list(c) for c in t.counters]]]
+    tip = ext.header.tip
+    return [led_obj, list(led.transitions),
+            None if tip is None else [tip.slot, tip.block_no, tip.hash,
+                                      int(tip.is_ebb)],
+            dep_obj, list(dep.transitions)]
+
+
+def _dec_state(obj):
+    led_obj, led_tr, tip_obj, dep_obj, dep_tr = obj
+    if int(led_obj[0]) == BYRON:
+        u, d, slot, tipenc, upd = led_obj[1]
+        inner = ByronLedgerState(
+            tuple((bytes(t), int(i), bytes(a), int(m)) for t, i, a, m in u),
+            tuple(bytes(x) for x in d), int(slot), Point.decode(tipenc),
+            int(upd))
+    else:
+        u, dl, pl, ep, sm, ss, slot, tipenc = led_obj[1]
+        inner = ShelleyLedgerState(
+            tuple((bytes(t), int(i), bytes(a), int(m),
+                   tuple((bytes(x), int(q)) for x, q in assets))
+                  for t, i, a, m, assets in u),
+            tuple((bytes(a), bytes(p)) for a, p in dl),
+            tuple((bytes(p), bytes(v)) for p, v in pl),
+            int(ep),
+            tuple((bytes(p), int(s), bytes(v)) for p, s, v in sm),
+            tuple((bytes(p), int(s), bytes(v)) for p, s, v in ss),
+            int(slot), Point.decode(tipenc))
+    led = HardForkState(int(led_obj[0]), inner,
+                        tuple(int(t) for t in led_tr))
+    if int(dep_obj[0]) == BYRON:
+        dep_inner = tuple(int(x) for x in dep_obj[1])
+    else:
+        ep, e0, ev, ec, cs = dep_obj[1]
+        dep_inner = TPraosState(int(ep), bytes(e0), bytes(ev), bytes(ec),
+                                tuple((bytes(p), int(c)) for p, c in cs))
+    dep = HardForkState(int(dep_obj[0]), dep_inner,
+                        tuple(int(t) for t in dep_tr))
+    tip = None if tip_obj is None else AnnTip(
+        int(tip_obj[0]), int(tip_obj[1]), bytes(tip_obj[2]),
+        bool(tip_obj[3]))
+    return ExtLedgerState(led, HeaderState(tip, dep))
+
+
+def _block_decode(raw):
+    return cardano_block_decode(cbor.loads(raw))
+
+
+def _cardano_tx_decode(obj):
+    """Wire decode for mempool relay: Byron txs (3 body fields + wits)
+    vs Shelley txs (5 body fields + wits) distinguished by arity."""
+    from ouroboros_tpu.eras.byron import ByronTx
+    from ouroboros_tpu.eras.shelley import ShelleyTx
+    return ByronTx.decode(obj) if len(obj) == 4 else ShelleyTx.decode(obj)
+
+
+def _make_node(i, eras, rules, nodes):
+    fs = MockFS()
+    db = ChainDB.open(fs, rules, _enc_state, _dec_state, _block_decode,
+                      backend=BACKEND)
+    ledger = rules.ledger
+    mempool = Mempool(ledger, lambda db=db: (db.current_ledger.ledger,
+                                             db.tip_point()),
+                      backend=BACKEND)
+    node = nodes[i]
+    forging = BlockForging(
+        issuer=i,
+        can_be_leader={BYRON: i, SHELLEY: node["can_be_leader"]},
+        forge=hfc_forge(eras, {
+            BYRON: lambda p, proof, hdr, n=node: byron_sign_header(
+                n["delegate_sk"], hdr),
+            SHELLEY: lambda p, proof, hdr, n=node: forge_tpraos_fields(
+                p, n["hot_key"], n["can_be_leader"], proof, hdr),
+        }))
+    btime = HardForkBlockchainTime(
+        lambda db=db, ledger=ledger:
+            ledger.summary(db.current_ledger.ledger))
+    return NodeKernel(
+        db, ledger, mempool, btime, [forging], label=f"cardano{i}",
+        backend=BACKEND, chain_sync_window=8,
+        header_decode=ProtocolHeader.decode,
+        block_decode_obj=cardano_block_decode,
+        tx_decode=_cardano_tx_decode)
+
+
+def test_real_era_network_crosses_fork():
+    eras, rules, nodes = cardano_setup(N_NODES, epoch_length=EPOCH)
+
+    async def main():
+        kernels = [_make_node(i, eras, rules, nodes) for i in range(N_NODES)]
+        for k in kernels:
+            k.start()
+        for i in range(N_NODES):
+            for j in range(i + 1, N_NODES):
+                connect_nodes(kernels[i], kernels[j], delay=0.02)
+        # announce the fork through the LEDGER: a Byron update-proposal tx
+        # submitted to one node's mempool and diffused
+        upd = make_byron_tx(
+            inputs=[], outputs=[],
+            certs=[(CERT_UPDATE, FORK_EPOCH.to_bytes(8, "big"), b"")],
+            signing_keys=[nodes[0]["genesis_sk"]])
+        await sim.sleep(0.5)
+        accepted, _rej = kernels[0].mempool.try_add_txs([upd])
+        assert accepted, 'update proposal rejected by the mempool'
+        # byron: slots 0..19 at 1s; shelley: 0.5s slots; run to ~slot 40
+        await sim.sleep(20.0 + 10.0 + 1.0)
+        out = []
+        for k in kernels:
+            chain = k.chain_db.current_chain.copy()
+            imm_tags = []
+            for entry, raw in k.chain_db.immutable.stream():
+                imm_tags.append(_block_decode(raw).header.get(ERA_FIELD))
+            out.append((chain, imm_tags, k.chain_db.current_ledger))
+            for t in k._threads:
+                try:
+                    t.poll()
+                except sim.AsyncCancelled:
+                    pass
+                except BaseException as e:
+                    raise AssertionError(
+                        f"{k.label}/{t.label} failed: {e!r}") from e
+            k.stop()
+        return out
+
+    results = sim.run(main(), seed=23)
+    for chain, imm_tags, ext in results:
+        tags = imm_tags + [b.header.get(ERA_FIELD) for b in chain.blocks]
+        assert BYRON in tags, "no Byron blocks"
+        assert SHELLEY in tags, "network never crossed the fork"
+        assert tags == sorted(tags), f"era tags not monotone: {tags}"
+        assert ext.ledger.era == SHELLEY
+        assert ext.ledger.transitions == (FORK_EPOCH,)
+        s_slots = [b.slot for b in chain.blocks
+                   if b.header.get(ERA_FIELD) == SHELLEY]
+        assert all(s >= FORK_EPOCH * EPOCH for s in s_slots)
+    heads = [c.head_block_no for c, _, _ in results]
+    assert max(heads) - min(heads) <= 2
+    assert min(heads) >= 10

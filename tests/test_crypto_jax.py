@@ -137,3 +137,54 @@ def test_jax_backend_vrf_and_kes():
         == [True] * 5 + [False]
     assert jb.verify_kes_batch(kess) == ref.verify_kes_batch(kess) \
         == [True] * 5 + [False]
+
+
+def test_vrf_jax_batch_parity_and_betas():
+    """batch_verify_vrf + batch_betas vs the pure-Python oracle, incl.
+    tampered gamma/c/s, wrong vk, wrong alpha, garbage proofs."""
+    import hashlib
+
+    from ouroboros_tpu.crypto import vrf_jax, vrf_ref
+
+    sks = [hashlib.sha256(b"vk%d" % i).digest() for i in range(3)]
+    vks = [vrf_ref.public_key(sk) for sk in sks]
+    vs, als, pis = [], [], []
+    for i in range(12):
+        als.append(b"al-%d" % i)
+        vs.append(vks[i % 3])
+        pis.append(vrf_ref.prove(sks[i % 3], als[-1]))
+    pis[1] = pis[1][:10] + bytes([pis[1][10] ^ 1]) + pis[1][11:]   # gamma
+    pis[2] = pis[2][:40] + bytes([pis[2][40] ^ 1]) + pis[2][41:]   # c
+    pis[3] = pis[3][:60] + bytes([pis[3][60] ^ 1]) + pis[3][61:]   # s
+    vs[4] = b"\x00" * 32
+    als[5] = b"other"
+    pis[6] = b"\x01" * 80
+    pis[7] = b"short"
+    oks, betas = vrf_jax.batch_verify_vrf(vs, als, pis, pad_to=16)
+    assert oks == [vrf_ref.verify(v, a, p)
+                   for v, a, p in zip(vs, als, pis)]
+    for j in range(12):
+        try:
+            want = vrf_ref.proof_to_hash(pis[j])
+        except ValueError:
+            want = None
+        assert betas[j] == want
+    assert vrf_jax.batch_betas(pis, pad_to=16) == betas
+
+
+def test_beta_prefetch_cache_used_in_seq_pass():
+    """TPraos prefetch_window fills the cache; sequential_checks then
+    agrees with the uncached path."""
+    import hashlib
+
+    from ouroboros_tpu.crypto.backend import OpensslBackend, VrfBetaCache
+    from ouroboros_tpu.crypto import vrf_ref
+
+    cache = VrfBetaCache()
+    sk = hashlib.sha256(b"c").digest()
+    pi = vrf_ref.prove(sk, b"msg")
+    cache.prefetch([pi, b"junk" * 20], OpensslBackend())
+    assert cache.get(pi) == vrf_ref.proof_to_hash(pi)
+    import pytest
+    with pytest.raises(ValueError):
+        cache.get(b"junk" * 20)

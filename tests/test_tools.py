@@ -124,3 +124,37 @@ def test_shelley_replay_detects_tamper(shelley_db, tmp_path):
     r = _run("tools/db_analyser.py", bad, "--validate", "full",
              "--backend", "openssl", "--window", "16")
     assert r.returncode != 0
+
+
+# ---------------------------------------------------------------------------
+# Cardano (Byron->Shelley) cross-fork replay (BASELINE config #5)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cardano_db(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("cardanodb"))
+    r = _run("tools/db_synth.py", "--out", d, "--protocol", "cardano",
+             "--blocks", "60", "--epoch-length", "10", "--pools", "2")
+    assert r.returncode == 0, r.stderr
+    info = json.loads(r.stdout.strip().splitlines()[-1])
+    assert info["blocks"] == 60 and info["fork_epoch"] >= 1
+    return d
+
+
+def test_cardano_replay_crosses_fork_with_parity(cardano_db):
+    r1 = _run("tools/db_analyser.py", cardano_db, "--validate", "full",
+              "--backend", "cpp", "--window", "16")
+    assert r1.returncode == 0, r1.stderr
+    r2 = _run("tools/db_analyser.py", cardano_db, "--validate", "reapply")
+    assert r2.returncode == 0, r2.stderr
+    i1, i2 = json.loads(r1.stdout), json.loads(r2.stdout)
+    assert i1["state_hash"] == i2["state_hash"]
+
+
+def test_cardano_chain_has_both_eras_and_ebbs(cardano_db):
+    r = _run("tools/db_analyser.py", cardano_db,
+             "--analysis", "show-slot-block-no")
+    assert r.returncode == 0, r.stderr
+    # EBBs share their successor's slot: expect at least one duplicate slot
+    slots = [int(l.split("\t")[0]) for l in r.stdout.strip().splitlines()]
+    assert len(slots) != len(set(slots)), "no EBB/successor slot pair"

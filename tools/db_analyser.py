@@ -57,6 +57,21 @@ def load_db(db_dir: str):
         ledger = MockLedger({bytes.fromhex(vk): amt
                              for vk, amt in cfg["genesis"].items()})
         tx_decode = Tx.decode
+    elif cfg["protocol"] == "cardano":
+        from ouroboros_tpu.eras.cardano import (
+            cardano_block_decode, cardano_setup,
+        )
+        _eras, rules, _nodes = cardano_setup(
+            cfg["nodes"], epoch_length=cfg["epoch_length"],
+            seed=cfg["seed"].encode())
+        fs = IoFS(db_dir)
+        db = ImmutableDB.open(fs, cfg.get("chunk_size", 100),
+                              validate_all=False)
+
+        def decode_cardano(raw: bytes):
+            return cardano_block_decode(cbor.loads(raw))
+
+        return db, rules, decode_cardano, cfg
     elif cfg["protocol"] == "shelley":
         from fractions import Fraction
 
@@ -136,41 +151,51 @@ def analysis_show_header_size(db, decode, out):
     out.write(f"# max header size {biggest[0]} at slot {biggest[1]}\n")
 
 
-# proofs per header: mock-praos = VRF + KES; shelley = 2 VRF + KES + OCert
-HEADER_PROOFS = {"mock-praos": 2, "shelley": 4}
+# proofs per header: mock-praos = VRF + KES; shelley = 2 VRF + KES + OCert;
+# cardano = per era (Byron delegate sig | Shelley's 4; EBBs carry none)
+def _cardano_hdr_proofs(b) -> int:
+    if b.header.get("ebb"):
+        return 0
+    return 1 if b.header.get("hfc_era") == 0 else 4
+
+
+HEADER_PROOFS = {"mock-praos": 2, "shelley": 4,
+                 "cardano": _cardano_hdr_proofs}
 
 
 def analysis_validate(db, rules, decode, backend_name: str, mode: str,
                       window: int, out, hdr_proofs: int = 2):
-    from ouroboros_tpu.consensus.batch import validate_blocks_batched
+    from ouroboros_tpu.consensus.batch import replay_blocks_pipelined
 
     backend = make_backend(backend_name) if mode == "full" else None
+    hdr_count = hdr_proofs if callable(hdr_proofs) \
+        else (lambda b, n=hdr_proofs: n)
     ext = rules.initial_state()
-    blocks = proofs = 0
+    counts = {"blocks": 0, "proofs": 0}
     t0 = time.time()
-    buf = []
-    for entry, raw in db.stream():
-        b = decode(raw)
-        blocks += 1
-        proofs += hdr_proofs + sum(len(tx.witnesses) for tx in b.body)
-        if mode == "reapply":
+    if mode == "reapply":
+        for entry, raw in db.stream():
+            b = decode(raw)
+            counts["blocks"] += 1
+            counts["proofs"] += hdr_count(b) + sum(len(tx.witnesses)
+                                                   for tx in b.body)
             ext = rules.tick_then_reapply(ext, b)
-            continue
-        buf.append(b)
-        if len(buf) >= window:
-            res = validate_blocks_batched(rules, buf, ext, backend=backend)
-            if not res.all_valid:
-                raise SystemExit(
-                    f"validation FAILED at block {blocks - len(buf) + res.n_valid}: "
-                    f"{res.error}")
-            ext = res.final_state
-            buf = []
-    if mode == "full" and buf:
-        res = validate_blocks_batched(rules, buf, ext, backend=backend)
+    else:
+        def stream_blocks():
+            for entry, raw in db.stream():
+                b = decode(raw)
+                counts["blocks"] += 1
+                counts["proofs"] += hdr_count(b) + sum(len(tx.witnesses)
+                                                       for tx in b.body)
+                yield b
+        res = replay_blocks_pipelined(rules, stream_blocks(), ext,
+                                      backend=backend, window=window)
         if not res.all_valid:
-            raise SystemExit(f"validation FAILED: {res.error}")
+            raise SystemExit(
+                f"validation FAILED at block {res.n_valid}: {res.error}")
         ext = res.final_state
     secs = time.time() - t0
+    blocks, proofs = counts["blocks"], counts["proofs"]
     out.write(json.dumps({
         "analysis": "validate", "mode": mode,
         "backend": backend_name if mode == "full" else "n/a",

@@ -246,11 +246,111 @@ def synth_shelley(args) -> dict:
     return {"blocks": forged, "last_slot": slot - 1}
 
 
+def synth_cardano(args) -> dict:
+    """Forge a Byron->Shelley chain crossing the hard fork (BASELINE
+    config #5 shape): PBFT blocks + EBBs, a Byron update proposal naming
+    the fork epoch, then TPraos blocks — all through the combinator."""
+    from ouroboros_tpu.consensus.hardfork.combinator import ERA_FIELD
+    from ouroboros_tpu.consensus.headers import ProtocolBlock, make_header
+    from ouroboros_tpu.eras.byron import (
+        CERT_UPDATE, byron_sign_header, make_byron_tx, make_ebb,
+    )
+    from ouroboros_tpu.eras.cardano import (
+        BYRON, SHELLEY, cardano_setup,
+    )
+    from ouroboros_tpu.eras.shelley import forge_tpraos_fields
+    from ouroboros_tpu.storage.fs import IoFS
+    from ouroboros_tpu.storage.immutabledb import ImmutableDB
+
+    epoch_length = args.epoch_length
+    fork_epoch = max(1, args.blocks // (2 * epoch_length))
+    eras, rules, nodes = cardano_setup(
+        args.pools, epoch_length=epoch_length, seed=args.seed.encode())
+
+    os.makedirs(args.out, exist_ok=True)
+    with open(os.path.join(args.out, "config.json"), "w") as fh:
+        json.dump({
+            "protocol": "cardano", "nodes": args.pools,
+            "epoch_length": epoch_length, "seed": args.seed,
+            "fork_epoch": fork_epoch, "chunk_size": args.chunk_size,
+        }, fh, indent=2)
+    fs = IoFS(args.out)
+    db = ImmutableDB.open(fs, args.chunk_size, validate_all=False)
+
+    byron_era, shelley_era = eras
+    state = rules.initial_state()
+    prev = None
+    slot = 0
+    forged = 0
+    update_sent = False
+    t0 = time.time()
+
+    def append(blk):
+        db.append_block(blk.slot, blk.block_no, blk.hash, blk.prev_hash,
+                        blk.bytes, is_ebb=bool(blk.header.get("ebb", 0)))
+
+    while forged < args.blocks:
+        view = rules.ledger.ledger_view(rules.ledger.tick(state.ledger,
+                                                          slot))
+        ticked_dep = rules.protocol.tick_chain_dep_state(
+            state.header.chain_dep_state, view, slot)
+        if ticked_dep.era == BYRON:
+            if slot % epoch_length == 0 and slot > 0:
+                ebb = make_ebb(prev, slot // epoch_length, epoch_length)
+                ebb = ebb.with_fields(**{ERA_FIELD: BYRON})
+                blk = ProtocolBlock(ebb, ())
+                state = rules.tick_then_reapply(state, blk)
+                append(blk)
+                forged += 1
+                prev = ebb
+            leader_ix = byron_era.protocol.slot_leader(slot)
+            node = nodes[leader_ix]
+            body = []
+            if not update_sent:
+                body.append(make_byron_tx(
+                    inputs=[], outputs=[],
+                    certs=[(CERT_UPDATE, fork_epoch.to_bytes(8, "big"),
+                            b"")],
+                    signing_keys=[node["genesis_sk"]]))
+                update_sent = True
+            hdr = make_header(prev, slot, body, issuer=leader_ix)
+            hdr = hdr.with_fields(**{ERA_FIELD: BYRON})
+            hdr = byron_sign_header(node["delegate_sk"], hdr)
+            blk = ProtocolBlock(hdr, tuple(body))
+        else:
+            lead = node = None
+            for node in nodes:
+                lead = shelley_era.protocol.check_is_leader(
+                    node["can_be_leader"], slot, ticked_dep.inner,
+                    view.inner)
+                if lead is not None:
+                    break
+            if lead is None:
+                slot += 1
+                continue
+            hdr = make_header(prev, slot, (), issuer=0)
+            hdr = hdr.with_fields(**{ERA_FIELD: SHELLEY})
+            hdr = forge_tpraos_fields(shelley_era.protocol, node["hot_key"],
+                                      node["can_be_leader"], lead, hdr)
+            blk = ProtocolBlock(hdr, ())
+        state = rules.tick_then_reapply(state, blk)
+        append(blk)
+        prev = blk.header
+        forged += 1
+        slot += 1
+        if forged % 500 == 0:
+            print(f"  forged {forged}/{args.blocks} "
+                  f"({forged / (time.time() - t0):.0f} blocks/s)",
+                  file=sys.stderr)
+    return {"blocks": forged, "last_slot": slot - 1,
+            "fork_epoch": fork_epoch}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", required=True, help="target directory")
     ap.add_argument("--protocol", default="mock-praos",
-                    choices=["mock-praos", "shelley"])
+                    choices=["mock-praos", "shelley", "cardano"])
     ap.add_argument("--blocks", type=int, default=1000)
     ap.add_argument("--txs-per-block", type=int, default=2)
     ap.add_argument("--nodes", type=int, default=4,
@@ -268,6 +368,8 @@ def main() -> None:
     t0 = time.time()
     if args.protocol == "shelley":
         info = synth_shelley(args)
+    elif args.protocol == "cardano":
+        info = synth_cardano(args)
     else:
         info = synth_mock_praos(args)
     info.update({"protocol": args.protocol, "dir": args.out,
