@@ -51,6 +51,7 @@ class PeerGSVTracker:
         self.alpha = alpha
         self.gsv = PeerGSV()
         self._rtt_count = 0
+        self._owd_count = 0
 
     def observe_rtt(self, rtt: float) -> None:
         """A KeepAlive round-trip for a tiny payload: attribute half to
@@ -59,7 +60,9 @@ class PeerGSVTracker:
         self._rtt_count += 1
         out, inn = self.gsv.outbound, self.gsv.inbound
         if self._rtt_count == 1:
-            self.gsv = PeerGSV(replace(out, g=half), replace(inn, g=half))
+            # keep a better inbound G already learned from SDU timestamps
+            in_g = min(inn.g, half) if self._owd_count else half
+            self.gsv = PeerGSV(replace(out, g=half), replace(inn, g=in_g))
             return
         new_out = self._update_dir(out, half)
         new_in = self._update_dir(inn, half)
@@ -70,6 +73,26 @@ class PeerGSVTracker:
         dev = max(0.0, sample_g - g)
         v = (1 - self.alpha) * d.v + self.alpha * dev
         return replace(d, g=g, v=v)
+
+    def observe_owd(self, owd: float, nbytes: int) -> None:
+        """A per-SDU one-way-delay sample from the mux demuxer's
+        timestamp difference (DeltaQ/TraceStats.hs): min-tracked G,
+        deviations into V, and for sized SDUs a per-byte S refinement —
+        passive estimation with no KeepAlive traffic needed."""
+        inn = self.gsv.inbound
+        # first inbound sample initialises G (0.0 default = "unmeasured");
+        # a separate counter so RTT/transfer initialisation stays intact
+        first = self._owd_count == 0 and self._rtt_count == 0
+        g = owd if first else min(inn.g, owd)
+        dev = max(0.0, owd - g)
+        v = (1 - self.alpha) * inn.v + self.alpha * dev
+        s = inn.s
+        if nbytes >= 4096 and owd > g:
+            s_sample = (owd - g) / nbytes
+            s = min(s, s_sample)
+        self.gsv = PeerGSV(self.gsv.outbound,
+                           replace(inn, g=g, v=v, s=s))
+        self._owd_count += 1
 
     def observe_transfer(self, nbytes: int, duration: float) -> None:
         """A sized inbound transfer (a BlockFetch batch): refine S as the

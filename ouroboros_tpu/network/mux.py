@@ -125,9 +125,13 @@ class MuxChannel:
 class Mux:
     """The mux proper: fair egress servicing + demux (Mux.hs:176-282)."""
 
-    def __init__(self, bearer, label: str = "mux"):
+    def __init__(self, bearer, label: str = "mux", owd_observer=None):
         self.bearer = bearer
         self.label = label
+        # owd_observer(owd_seconds, sdu_bytes): fed one sample per received
+        # SDU from the header timestamp (DeltaQ/TraceStats.hs) — passive
+        # latency estimation riding the normal traffic
+        self.owd_observer = owd_observer
         self._channels: dict[tuple[int, int], MuxChannel] = {}
         self._jobs: list = []
         # bumped on channel registration so the egress loop's STM retry
@@ -187,6 +191,13 @@ class Mux:
         (Ingress.hs:100-122 MuxIngressQueueOverRun semantics)."""
         while True:
             sdu = await self.bearer.read()
+            if self.owd_observer is not None:
+                # 32-bit µs wraparound-safe one-way delay from the sender's
+                # RemoteClockModel timestamp (TraceStats.hs)
+                now_us = int(sim.now() * 1e6) & 0xFFFFFFFF
+                delta = (now_us - sdu.timestamp) & 0xFFFFFFFF
+                if delta < 1 << 31:          # sane (not clock-behind)
+                    self.owd_observer(delta / 1e6, len(sdu.payload) + 8)
             # the sender's direction bit is flipped on receive: the remote
             # initiator's data feeds our responder-side channel (Ingress.hs)
             key = (sdu.num, 1 - sdu.mode)

@@ -61,6 +61,12 @@ class KnownPeers:
             info.fail_count += 1
             info.reconnect_at = max(info.reconnect_at, until)
 
+    def cooldown(self, addr, until: float) -> None:
+        """Churn cool-down: delay re-selection WITHOUT counting a failure."""
+        info = self.peers.get(addr)
+        if info is not None:
+            info.reconnect_at = max(info.reconnect_at, until)
+
     def available(self, now: float, exclude=()) -> list:
         ex = set(exclude)
         return sorted((a for a, i in self.peers.items()
@@ -163,7 +169,14 @@ class PeerSelectionActions:
     PeerSelectionActions record): override in the integration layer."""
 
     async def request_peers(self) -> Sequence:
-        """Gossip/ledger/root peer discovery: return new addrs."""
+        """Root/ledger peer discovery: return new addrs (RootPeersDNS /
+        LedgerPeers role)."""
+        return []
+
+    async def gossip(self, addr) -> Sequence:
+        """Ask one established peer for ITS known peers (the gossip /
+        peer-sharing requests of Governor.hs's known-peers-below-target
+        job).  Default: nothing."""
         return []
 
     async def connect(self, addr) -> bool:
@@ -188,18 +201,23 @@ class PeerSelectionGovernor:
     def __init__(self, targets: PeerSelectionTargets,
                  actions: PeerSelectionActions,
                  seed: int = 0, retry_interval: float = 5.0,
-                 suspend_base: float = 10.0):
+                 suspend_base: float = 10.0,
+                 gossip_interval: float = 30.0,
+                 self_addr=None):
         assert targets.sane()
         self.targets = targets
         self.actions = actions
         self.rng = random.Random(seed)
         self.retry_interval = retry_interval
         self.suspend_base = suspend_base
+        self.gossip_interval = gossip_interval
+        self.self_addr = self_addr
         self.known = KnownPeers()
         self.established: set = set()
         self.active: set = set()
         self.wakeup = TVar(0, label="governor-wakeup")
         self._v = 0
+        self._last_gossip: Dict[object, float] = {}
         self.trace: list = []
 
     def poke(self) -> None:
@@ -232,7 +250,19 @@ class PeerSelectionGovernor:
         self.trace.append((sim.now(), d.kind, d.addr))
         if d.kind == REQUEST_MORE_PEERS:
             for a in await self.actions.request_peers():
-                self.known.add(a)
+                self.known.add(a, source="root")
+            # gossip round: ask established peers (not recently asked) for
+            # their peers — the transitive discovery that fills KnownPeers
+            # past the root set (Governor.hs known-peers job)
+            now = sim.now()
+            for peer in sorted(self.established, key=str):
+                if now - self._last_gossip.get(peer, -1e9) \
+                        < self.gossip_interval:
+                    continue
+                self._last_gossip[peer] = now
+                for a in await self.actions.gossip(peer):
+                    if a != self.self_addr:
+                        self.known.add(a, source="gossip")
         elif d.kind == PROMOTE_COLD:
             ok = await self.actions.connect(d.addr)
             if ok:
@@ -253,6 +283,29 @@ class PeerSelectionGovernor:
         elif d.kind == DEMOTE_WARM:
             await self.actions.disconnect(d.addr)
             self.established.discard(d.addr)
+
+    async def churn_round(self) -> Optional[object]:
+        """One churn step (peerChurnGovernor, Governor.hs:557): demote a
+        random hot peer to cold with a cool-down so the replacement is a
+        DIFFERENT peer — continuous rotation keeps the peer graph fresh
+        and defeats eclipse-by-staleness.  Returns the rotated peer."""
+        if not self.active:
+            return None
+        victim = self.rng.choice(sorted(self.active, key=str))
+        self.trace.append((sim.now(), "churn", victim))
+        await self.actions.deactivate(victim)
+        self.active.discard(victim)
+        await self.actions.disconnect(victim)
+        self.established.discard(victim)
+        self.known.cooldown(victim, sim.now() + self.retry_interval)
+        self.poke()
+        return victim
+
+    async def run_churn(self, interval: float = 60.0) -> None:
+        """The churn loop; fork alongside run()."""
+        while True:
+            await sim.sleep(interval)
+            await self.churn_round()
 
     async def run(self) -> None:
         while True:

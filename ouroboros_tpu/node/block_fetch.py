@@ -31,20 +31,35 @@ class FetchRequest:
     peer_id: object
     start: Point
     headers: tuple
+    est_bytes: int = 0               # in-flight byte accounting estimate
 
     @property
     def end(self) -> Point:
         return point_of(self.headers[-1])
 
 
+@dataclass(frozen=True)
+class FetchBudget:
+    """The request-sizing limits of fetchRequestDecisions
+    (Decision.hs:526): per-peer in-flight bytes (the low/high watermark
+    pair collapsed to one cap), a network-wide concurrency budget, and a
+    DeltaQ bound on a single request's expected duration."""
+    max_blocks_per_request: int = 16
+    max_in_flight_bytes_per_peer: int = 256 * 1024
+    max_concurrent_peers: int = 4
+    max_request_expected_secs: float = 5.0
+
+
 class PeerFetchState:
     """Per-peer fetch bookkeeping (ClientState.hs `PeerFetchStatus` +
-    request queue)."""
+    request queue + in-flight byte/size tracking)."""
 
     def __init__(self, peer_id):
         self.peer_id = peer_id
         self.queue = TQueue(label=f"fetch-req-{peer_id}")
         self.in_flight: set[bytes] = set()     # header hashes requested
+        self.in_flight_bytes: int = 0          # estimated bytes outstanding
+        self.avg_block_bytes: int = 2048       # refined from transfers
         # scan frontier: everything on the candidate up to this point is
         # known-stored, so decision rounds skip it (keeps a long sync from
         # rescanning the fragment from its anchor every round)
@@ -54,31 +69,54 @@ class PeerFetchState:
     def busy(self) -> bool:
         return bool(self.in_flight)
 
+    def observe_blocks(self, n_blocks: int, n_bytes: int) -> None:
+        if n_blocks:
+            self.avg_block_bytes = max(
+                64, (self.avg_block_bytes + n_bytes // n_blocks) // 2)
+
 
 def fetch_decisions(
         candidates: Dict[object, object],
         peer_states: Dict[object, PeerFetchState],
         plausible: Callable[[object], bool],
         have_block: Callable[[bytes], bool],
-        max_blocks_per_request: int = 16,
-        order_key: Optional[Callable[[object], float]] = None
+        max_blocks_per_request: Optional[int] = None,
+        order_key: Optional[Callable[[object], float]] = None,
+        budget: Optional[FetchBudget] = None,
+        gsv: Optional[Callable[[object], object]] = None
         ) -> list[FetchRequest]:
-    """The pure decision pipeline (Decision.hs:150-184).
+    """The pure decision pipeline (Decision.hs:150-184,526).
 
     candidates: peer -> AnchoredFragment of validated headers (or None).
     plausible:  fragment -> would we prefer this chain over ours?
     have_block: hash -> already stored in the ChainDB?
+    gsv:        peer -> PeerGSV tracker (None: no DeltaQ sizing).
 
-    Per peer, at most one outstanding request (the reference allows a
-    configurable in-flight budget; one range per peer keeps requests maximal
-    and peers busy).  Blocks in flight with ANY peer are not re-requested
-    (filter already-in-flight), so concurrent peers fetch disjoint runs.
+    Filter plausible → filter fetched/in-flight → prioritise (longest
+    candidate, then cheapest peer by DeltaQ) → size requests within the
+    FetchBudget: per-peer in-flight byte cap, network concurrency budget,
+    and a DeltaQ bound on each request's expected duration — a slow peer
+    gets small requests (or none, when faster peers cover its candidate),
+    a fast peer saturates.
     """
+    # one source of truth for request sizing: an explicit
+    # max_blocks_per_request overrides the budget's field
+    if budget is None:
+        budget = FetchBudget(
+            max_blocks_per_request=max_blocks_per_request or 16)
+    elif max_blocks_per_request is not None:
+        from dataclasses import replace as _replace
+        budget = _replace(budget,
+                          max_blocks_per_request=max_blocks_per_request)
     claimed: set[bytes] = set()
+    busy_count = 0
     for ps in peer_states.values():
         claimed |= ps.in_flight
-        for req in _queued(ps.queue):
+        queued = _queued(ps.queue)
+        for req in queued:
             claimed |= {h.hash for h in req.headers}
+        if ps.busy or queued:
+            busy_count += 1
 
     decisions: list[FetchRequest] = []
     # deterministic peer order: better candidates first, then cheaper peers
@@ -90,11 +128,27 @@ def fetch_decisions(
         return (-bn, dq, str(peer))
 
     for peer, frag in sorted(candidates.items(), key=head_key):
+        if busy_count >= budget.max_concurrent_peers:
+            break                        # concurrency budget exhausted
         if frag is None or len(frag) == 0 or not plausible(frag):
             continue
         ps = peer_states.get(peer)
         if ps is None or ps.busy or _queued(ps.queue):
             continue
+        # per-peer byte budget + DeltaQ request sizing
+        est = ps.avg_block_bytes
+        bytes_left = budget.max_in_flight_bytes_per_peer \
+            - ps.in_flight_bytes
+        if bytes_left < est:
+            continue
+        cap = min(budget.max_blocks_per_request, max(1, bytes_left // est))
+        tracker = gsv(peer) if gsv is not None else None
+        if tracker is not None:
+            n = 1
+            while n < cap and tracker.expected_fetch_time(
+                    (n + 1) * est) <= budget.max_request_expected_secs:
+                n += 1
+            cap = n
         # resume the scan at the stored frontier when it is still on the
         # fragment (a rollback may have invalidated it — then rescan)
         blocks = None
@@ -117,7 +171,7 @@ def fetch_decisions(
                 if not run:
                     start = prev_point
                 run.append(h)
-                if len(run) >= max_blocks_per_request:
+                if len(run) >= cap:
                     break
             elif run:
                 break                    # only the first contiguous run
@@ -132,9 +186,11 @@ def fetch_decisions(
                 frontier_ok = False
             prev_point = point_of(h)
         if run:
-            req = FetchRequest(peer, start, tuple(run))
+            req = FetchRequest(peer, start, tuple(run),
+                               est_bytes=len(run) * est)
             claimed |= {h.hash for h in run}
             decisions.append(req)
+            busy_count += 1
     return decisions
 
 
@@ -166,10 +222,12 @@ async def fetch_logic_loop(kernel) -> None:
             kernel.peer_fetch,
             kernel.plausible_candidate,
             kernel.have_block,
-            order_key=kernel.fetch_order_key)
+            order_key=kernel.fetch_order_key,
+            gsv=kernel.peer_gsv.get)
         for req in decisions:
             ps = kernel.peer_fetch[req.peer_id]
             ps.in_flight |= {h.hash for h in req.headers}
+            ps.in_flight_bytes += req.est_bytes
 
             def push(tx, ps=ps, req=req):
                 ps.queue.put(tx, req)
@@ -197,13 +255,17 @@ async def block_fetch_client(session, kernel, peer_id) -> None:
                 t0 = sim.now()
                 blocks = await fetch_range(session, req.start, req.end)
                 tracker = kernel.peer_gsv.get(peer_id)
-                if tracker is not None and blocks:
-                    tracker.observe_transfer(
-                        sum(len(b.bytes) for b in blocks), sim.now() - t0)
+                if blocks:
+                    total = sum(len(b.bytes) for b in blocks)
+                    if tracker is not None:
+                        tracker.observe_transfer(total, sim.now() - t0)
+                    ps.observe_blocks(len(blocks), total)
                 for b in blocks or ():
                     kernel.add_fetched_block(b)
             finally:
                 ps.in_flight -= {h.hash for h in req.headers}
+                ps.in_flight_bytes = max(0,
+                                         ps.in_flight_bytes - req.est_bytes)
             ps.done_through = req.end
             kernel.poke_fetch_logic()
     except sim.AsyncCancelled:
@@ -212,6 +274,7 @@ async def block_fetch_client(session, kernel, peer_id) -> None:
         sim.trace_event(("block-fetch-kill", kernel.label, peer_id,
                          repr(e)))
         ps.in_flight.clear()
+        ps.in_flight_bytes = 0
         kernel.drop_peer(peer_id)
         raise
 

@@ -112,8 +112,14 @@ class NodeKernel:
             self.chain_db.current_ledger.ledger, slot)
 
     def have_block(self, h: bytes) -> bool:
+        """Stored, queued for the writer thread, or buffered as a future
+        block — all count as "have" so fetch decisions never re-request
+        them (the reference's getIsFetched includes cdbBlocksToAdd)."""
         db = self.chain_db
-        return db.volatile.block_info(h) is not None or h in db.immutable
+        return (db.volatile.block_info(h) is not None
+                or h in db.immutable
+                or h in db.future_blocks
+                or any(b.hash == h for b in db._add_queue))
 
     def plausible_candidate(self, frag) -> bool:
         """Would we prefer this candidate over our current chain?
@@ -129,7 +135,10 @@ class NodeKernel:
             cur_view, self.protocol.select_view(head))
 
     def add_fetched_block(self, block) -> None:
-        self.chain_db.add_block(block)
+        """Fetched blocks go through the async queue — chain selection
+        runs only on the ChainDB writer thread (addBlockAsync,
+        BlockFetch.hs:169)."""
+        self.chain_db.add_block_async(block)
 
     def new_candidate(self, peer_id) -> CandidateState:
         c = CandidateState(peer_id)
@@ -159,14 +168,31 @@ class NodeKernel:
         """Fork the background threads (initNodeKernel, NodeKernel.hs:139,
         + the ChainDB background pipeline, Background.hs:84-102)."""
         self.btime.start(label=f"{self.label}-btime")
+        self.chain_db.current_slot_fn = lambda: self.btime.current.value
         self._threads.append(sim.spawn(fetch_logic_loop(self),
                                        label=f"{self.label}-fetch-logic"))
         self._threads.append(sim.spawn(self._background_loop(),
                                        label=f"{self.label}-chaindb-bg"))
+        self._threads.append(sim.spawn(self.chain_db.add_block_runner(),
+                                       label=f"{self.label}-add-block"))
+        self._threads.append(sim.spawn(self._slot_tick_loop(),
+                                       label=f"{self.label}-slot-tick"))
         for forging in self.forgings:
             self._threads.append(
                 sim.spawn(self._forging_loop(forging),
                           label=f"{self.label}-forge-{forging.issuer}"))
+
+    async def _slot_tick_loop(self) -> None:
+        """Re-triage buffered future blocks as their slots arrive
+        (cdbFutureBlocks rerun; Fragment/InFuture.hs clock-skew check)."""
+        last = self.btime.current.value - 1
+        while True:
+            slot = await self.btime.wait_slot_after(last)
+            last = slot
+            if self.chain_db.future_blocks:
+                for res in self.chain_db.on_slot_tick(slot):
+                    sim.trace_event(("future-block-adopted", self.label,
+                                     res.kind))
 
     async def _background_loop(self) -> None:
         """copyAndSnapshotRunner: whenever the chain grows past k, copy the
@@ -255,12 +281,16 @@ def _connect_directional(initiator: NodeKernel, responder: NodeKernel,
     negotiate-then-multiplex)."""
     peer_id = f"{initiator.label}->{responder.label}"
     bi, br = bearer_pair(sdu_size=sdu_size, delay=delay)
-    mux_i = Mux(bi, f"{peer_id}.mux-i")
+    # the initiator's GSV estimate for this peer is fed passively by the
+    # demuxer's per-SDU one-way delays (TraceStats.hs) on top of the
+    # KeepAlive RTT probes
+    tracker = PeerGSVTracker()
+    mux_i = Mux(bi, f"{peer_id}.mux-i", owd_observer=tracker.observe_owd)
     mux_r = Mux(br, f"{peer_id}.mux-r")
     mux_i.start()
     mux_r.start()
 
-    handle = sim.spawn(_run_initiator(initiator, mux_i, peer_id),
+    handle = sim.spawn(_run_initiator(initiator, mux_i, peer_id, tracker),
                        label=f"{peer_id}.connect-i")
     initiator._threads.append(handle)
     responder._threads.append(sim.spawn(
@@ -269,7 +299,8 @@ def _connect_directional(initiator: NodeKernel, responder: NodeKernel,
     return handle
 
 
-async def _run_initiator(initiator: NodeKernel, mux_i, peer_id) -> None:
+async def _run_initiator(initiator: NodeKernel, mux_i, peer_id,
+                         tracker=None) -> None:
     """The initiator-side connection runner.  Completes when the ChainSync
     client ends (the connection's liveness signal — Client.hs kill
     semantics); satellite protocols are cancelled on exit so subscription
@@ -307,7 +338,7 @@ async def _run_initiator(initiator: NodeKernel, mux_i, peer_id) -> None:
         block_fetch_client(bf_sess, initiator, peer_id),
         label=f"{peer_id}.bf-client"))
 
-    tracker = PeerGSVTracker()
+    tracker = tracker if tracker is not None else PeerGSVTracker()
     initiator.peer_gsv[peer_id] = tracker
     ka_sess = Session(
         ka_proto.SPEC, CLIENT,

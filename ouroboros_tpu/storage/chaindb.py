@@ -109,6 +109,18 @@ class ChainDB:
         self._followers: dict[int, Follower] = {}
         self._next_fid = 0
         self._last_snapshot_slot = -1
+        # in-future block buffering (cdbFutureBlocks + Fragment/InFuture.hs):
+        # blocks whose slot is past the wall clock (allowing max_clock_skew
+        # slots) wait here and re-triage when their slot arrives.  Enabled
+        # by giving the DB a clock (current_slot_fn); tools/replay leave it
+        # None (no wall clock — nothing is "future").
+        self.current_slot_fn: Optional[Callable[[], int]] = None
+        self.max_clock_skew_slots: int = 1
+        self.future_blocks: dict[bytes, Any] = {}
+        # async add-block queue (Background.hs addBlockRunner: ALL chain
+        # selection runs on one writer thread)
+        self._add_queue: list = []
+        self._add_wakeup = None                   # lazily created TVar
 
     def _anchor_block_no(self) -> int:
         t = self.immutable.tip
@@ -291,9 +303,63 @@ class ChainDB:
         imm_tip_slot = self.current_chain.anchor.slot
         if block.slot <= imm_tip_slot:
             return AddBlockResult("too_old", self.tip_point())
+        if self.current_slot_fn is not None:
+            now_slot = self.current_slot_fn()
+            if block.slot > now_slot + self.max_clock_skew_slots:
+                # from the future (clock skew beyond tolerance): buffer,
+                # re-triaged by on_slot_tick (cdbFutureBlocks)
+                self.future_blocks[h] = block
+                return AddBlockResult("from_future", self.tip_point())
         self.volatile.put_block(h, block.prev_hash, block.slot,
                                 block.block_no, block.bytes)
         return self._chain_selection_for(block)
+
+    def on_slot_tick(self, slot: int) -> list[AddBlockResult]:
+        """Re-triage buffered future blocks whose slot has arrived
+        (Background.hs's per-slot chain-selection rerun for
+        cdbFutureBlocks)."""
+        due = [b for h, b in self.future_blocks.items()
+               if b.slot <= slot + self.max_clock_skew_slots]
+        out = []
+        for b in sorted(due, key=lambda b: b.slot):
+            self.future_blocks.pop(b.hash, None)
+            out.append(self.add_block(b))
+        return out
+
+    # -- async add queue (Background.hs:84-102 addBlockRunner) ----------------
+    def _queue_wakeup(self):
+        if self._add_wakeup is None:
+            from ..simharness import TVar
+            self._add_wakeup = TVar(0, label="chaindb-add-queue")
+        return self._add_wakeup
+
+    def add_block_async(self, block: Any) -> None:
+        """Enqueue for the single writer thread (ChainDB.addBlockAsync):
+        callers never run chain selection themselves."""
+        self._add_queue.append(block)
+        wk = self._queue_wakeup()
+        try:
+            wk.set_notify(wk.value + 1)
+        except Exception:
+            wk._value = wk.value + 1
+
+    async def add_block_runner(self) -> None:
+        """The serialization point: drain the queue, one chain selection
+        at a time (the reference's addBlockRunner background thread)."""
+        from .. import simharness as sim
+        from ..simharness import Retry
+        wk = self._queue_wakeup()
+        while True:
+            while self._add_queue:
+                block = self._add_queue.pop(0)
+                res = self.add_block(block)
+                sim.trace_event(("add-block-async", res.kind, block.slot))
+            seen = wk.value
+
+            def wait(tx, seen=seen):
+                if tx.read(wk) == seen:
+                    raise Retry()
+            await sim.atomically(wait)
 
     def _chain_selection_for(self, block: Any) -> AddBlockResult:
         cur = self.current_chain

@@ -220,6 +220,45 @@ class PraosNetworkFactory:
             kern.network_magic = cfg.network_magics[i]
         return kern
 
+    def forge_at(self, i: int, slot: int, ext_state) -> ProtocolBlock:
+        """Forge node i's empty block at `slot` on ext_state's tip (test
+        helper for out-of-band blocks, e.g. clock-skew scenarios).  Node i
+        must lead the slot (use f=1.0 configs)."""
+        from ..chain.block import GENESIS_HASH
+        from ..consensus.headers import ProtocolHeader, body_hash_of
+        protocol = Praos(self.protocol_cfg)
+        ticked = protocol.tick_chain_dep_state(
+            ext_state.header.chain_dep_state, None, slot)
+        pi = protocol.check_is_leader((i, self.keys[i].vrf_sk), slot,
+                                      ticked, None)
+        assert pi is not None, f"node {i} does not lead slot {slot}"
+        ann = ext_state.header.tip
+        prev_hash = ann.hash if ann else GENESIS_HASH
+        block_no = ann.block_no + 1 if ann else 0
+        hdr = ProtocolHeader(slot=slot, block_no=block_no,
+                             prev_hash=prev_hash,
+                             body_hash=body_hash_of(()), issuer=i)
+        hot_key = HotKey(kes_mod.KesSignKey(self.cfg.kes_depth,
+                                            self.keys[i].kes_seed))
+        return ProtocolBlock(praos_forge_fields(protocol, hot_key, pi, hdr),
+                             ())
+
+    def forge_chain_from(self, i: int, ext_state, n: int) -> list:
+        """n connected empty blocks from ext_state's tip, one per slot."""
+        protocol = Praos(self.protocol_cfg)
+        ledger = MockLedger(self.genesis)
+        rules = ExtLedgerRules(protocol, ledger)
+        out = []
+        slot = (ext_state.header.tip.slot + 1
+                if ext_state.header.tip else 0)
+        st = ext_state
+        while len(out) < n:
+            blk = self.forge_at(i, slot, st)
+            st = rules.tick_then_reapply(st, blk)
+            out.append(blk)
+            slot += 1
+        return out
+
 
 def run_threadnet(cfg: ThreadNetConfig) -> ThreadNetResult:
     """Run the network to n_slots and collect final chains (runTestNetwork)."""

@@ -163,3 +163,151 @@ def test_threadnet_background_copy_to_immutable():
     for c in res.chains:
         assert len(c) <= cfg.k             # fragment trimmed to k
         assert c.anchor_block_no >= 0      # anchor advanced past genesis
+
+
+def test_future_block_buffered_until_its_slot():
+    """A block from the future (clock skew beyond tolerance) is buffered,
+    not adopted; at its slot it is re-triaged and adopted
+    (cdbFutureBlocks + Fragment/InFuture.hs)."""
+    from ouroboros_tpu import simharness as sim
+    from ouroboros_tpu.testing.threadnet import (
+        PraosNetworkFactory, ThreadNetConfig,
+    )
+    cfg = ThreadNetConfig(n_nodes=1, n_slots=30, k=5, f=1.0, seed=9)
+    factory = PraosNetworkFactory(cfg)
+
+    async def main():
+        kern = factory.make_node(0)
+        kern.start()
+        await sim.sleep(3.1)              # a few slots of local forging
+        tip = kern.chain_db.current_ledger
+        # forge a block 10 slots in the future on the current tip
+        future_slot = kern.btime.current.value + 10
+        blk = factory.forge_at(0, future_slot, tip)
+        res = kern.chain_db.add_block(blk)
+        assert res.kind == "from_future", res.kind
+        assert blk.hash in kern.chain_db.future_blocks
+        assert kern.chain_db.volatile.block_info(blk.hash) is None
+        # run until just before its slot: still buffered
+        await sim.sleep(8.0)
+        assert blk.hash in kern.chain_db.future_blocks
+        # at/after its slot the tick loop re-triages it
+        await sim.sleep(3.0)
+        assert blk.hash not in kern.chain_db.future_blocks
+        assert kern.chain_db.volatile.block_info(blk.hash) is not None
+        kern.stop()
+        return True
+
+    assert sim.run(main(), seed=9)
+
+
+def test_add_block_async_serialized_on_writer_thread():
+    """add_block_async enqueues; the runner adopts in order."""
+    from ouroboros_tpu import simharness as sim
+    from ouroboros_tpu.testing.threadnet import (
+        PraosNetworkFactory, ThreadNetConfig,
+    )
+    cfg = ThreadNetConfig(n_nodes=1, n_slots=30, k=5, f=1.0, seed=10)
+    factory = PraosNetworkFactory(cfg)
+
+    async def main():
+        kern = factory.make_node(0)
+        kern.btime.start(label="bt")
+        runner = sim.spawn(kern.chain_db.add_block_runner(), label="runner")
+        # forge 3 connected blocks by hand and enqueue them
+        state = kern.chain_db.current_ledger
+        blocks = factory.forge_chain_from(0, state, n=3)
+        for b in blocks:
+            kern.chain_db.add_block_async(b)
+        await sim.sleep(1.0)
+        assert kern.chain_db.tip_point().hash == blocks[-1].hash
+        runner.cancel()
+        return True
+
+    assert sim.run(main(), seed=10)
+
+
+class TestFetchBudgets:
+    """Decision.hs:526 fetchRequestDecisions budgets: bytes, concurrency,
+    DeltaQ request sizing (VERDICT r1 #6)."""
+
+    def _tracker(self, g, s):
+        from dataclasses import replace
+        from ouroboros_tpu.network.deltaq import PeerGSV, PeerGSVTracker
+        t = PeerGSVTracker()
+        t.gsv = PeerGSV(replace(t.gsv.outbound, g=g, s=0.0),
+                        replace(t.gsv.inbound, g=g, s=s))
+        return t
+
+    def test_slow_peer_gets_small_requests_fast_peer_saturates(self):
+        from ouroboros_tpu.node.block_fetch import (
+            FetchBudget, PeerFetchState, fetch_decisions,
+        )
+        hs = _header_chain(40)
+        # two peers advertise the same long candidate
+        frag = _frag(hs)
+        states = {"fast": PeerFetchState("fast"),
+                  "slow": PeerFetchState("slow")}
+        trackers = {"fast": self._tracker(0.01, 1e-6),   # ~2ms per block
+                    "slow": self._tracker(1.0, 1e-3)}    # ~2s per block
+        budget = FetchBudget(max_blocks_per_request=16,
+                             max_request_expected_secs=5.0,
+                             max_concurrent_peers=4)
+        reqs = fetch_decisions(
+            {"fast": frag, "slow": frag}, states,
+            lambda f: True, lambda h: False, budget=budget,
+            order_key=lambda p: trackers[p].expected_fetch_time(16 * 2048),
+            gsv=trackers.get)
+        by_peer = {r.peer_id: r for r in reqs}
+        # fast peer claims the first full-size run
+        assert len(by_peer["fast"].headers) == 16
+        # slow peer gets a DeltaQ-bounded (small) follow-on run
+        assert len(by_peer["slow"].headers) <= 2
+        # runs are disjoint
+        fast_h = {h.hash for h in by_peer["fast"].headers}
+        slow_h = {h.hash for h in by_peer["slow"].headers}
+        assert not (fast_h & slow_h)
+
+    def test_concurrency_budget_limits_peers(self):
+        from ouroboros_tpu.node.block_fetch import (
+            FetchBudget, PeerFetchState, fetch_decisions,
+        )
+        hs = _header_chain(64)
+        frag = _frag(hs)
+        states = {f"p{i}": PeerFetchState(f"p{i}") for i in range(6)}
+        budget = FetchBudget(max_blocks_per_request=4,
+                             max_concurrent_peers=2)
+        reqs = fetch_decisions({p: frag for p in states}, states,
+                               lambda f: True, lambda h: False,
+                               budget=budget)
+        assert len(reqs) == 2
+
+    def test_byte_budget_blocks_saturated_peer(self):
+        from ouroboros_tpu.node.block_fetch import (
+            FetchBudget, PeerFetchState, fetch_decisions,
+        )
+        hs = _header_chain(8)
+        frag = _frag(hs)
+        ps = PeerFetchState("p")
+        ps.in_flight_bytes = 300 * 1024      # over the 256 KiB cap
+        ps.in_flight = set()                 # not "busy" — just saturated
+        reqs = fetch_decisions({"p": frag}, {"p": ps},
+                               lambda f: True, lambda h: False,
+                               budget=FetchBudget())
+        assert reqs == []
+
+    def test_byte_budget_shrinks_request(self):
+        from ouroboros_tpu.node.block_fetch import (
+            FetchBudget, PeerFetchState, fetch_decisions,
+        )
+        hs = _header_chain(32)
+        frag = _frag(hs)
+        ps = PeerFetchState("p")
+        ps.avg_block_bytes = 2048
+        budget = FetchBudget(max_blocks_per_request=16,
+                             max_in_flight_bytes_per_peer=5 * 2048)
+        reqs = fetch_decisions({"p": frag}, {"p": ps},
+                               lambda f: True, lambda h: False,
+                               budget=budget)
+        assert len(reqs) == 1 and len(reqs[0].headers) == 5
+        assert reqs[0].est_bytes == 5 * 2048

@@ -210,3 +210,133 @@ def test_diffusion_joins_network_and_syncs():
     tips, heights = sim.run(main(), seed=9)
     assert min(heights) >= 5
     assert max(heights) - min(heights) <= 3
+
+
+# ---------------------------------------------------------------------------
+# gossip, churn, and governor properties (VERDICT r1 #4; Governor.hs:427-557,
+# PeerSelection/Test.hs property style)
+# ---------------------------------------------------------------------------
+
+class _GossipActions(PeerSelectionActions):
+    """A peer graph: roots are returned by discovery, the rest only via
+    gossip from connected peers."""
+
+    def __init__(self, roots, graph):
+        self.roots = list(roots)
+        self.graph = dict(graph)        # addr -> [addr its gossip returns]
+        self.log = []
+
+    async def request_peers(self):
+        return self.roots
+
+    async def gossip(self, addr):
+        self.log.append(("gossip", addr))
+        return self.graph.get(addr, [])
+
+    async def connect(self, addr):
+        return True
+
+    async def activate(self, addr):
+        return True
+
+
+def test_gossip_discovers_transitively():
+    """From one root peer, gossip rounds populate KnownPeers across the
+    whole reachable graph and targets are met."""
+    graph = {"root": ["a", "b"], "a": ["c", "d"], "b": ["e"],
+             "c": ["f", "g"], "d": ["h"]}
+    targets = PeerSelectionTargets(8, 6, 2)
+    acts = _GossipActions(["root"], graph)
+    gov = PeerSelectionGovernor(targets, acts, seed=3,
+                                gossip_interval=1.0, retry_interval=1.0)
+
+    async def main():
+        h = sim.spawn(gov.run(), label="governor")
+        await sim.sleep(60.0)
+        h.cancel()
+        return dict(gov.known.peers), len(gov.established), len(gov.active)
+
+    known, est, act = sim.run(main(), seed=3)
+    assert len(known) >= 8, sorted(known)
+    assert {"c", "d", "e", "f", "h"} <= set(known), \
+        "transitive peers not gossiped"
+    assert est == 6 and act == 2
+    # provenance recorded
+    assert known["root"].source == "root"
+    assert known["e"].source == "gossip"
+
+
+def test_churn_rotates_active_peers_and_targets_recover():
+    """The churn cycle demotes a hot peer; the governor promotes a
+    replacement and targets re-converge — active membership changes over
+    time (no eclipse-by-staleness)."""
+    targets = PeerSelectionTargets(6, 3, 2)
+    acts = _ScriptedActions([f"p{i}" for i in range(6)])
+    gov = PeerSelectionGovernor(targets, acts, seed=4, retry_interval=2.0)
+
+    async def main():
+        h = sim.spawn(gov.run(), label="governor")
+        c = sim.spawn(gov.run_churn(interval=5.0), label="churn")
+        seen_active = []
+        for _ in range(8):
+            await sim.sleep(5.0)
+            seen_active.append(frozenset(gov.active))
+        h.cancel()
+        c.cancel()
+        return seen_active
+
+    seen = sim.run(main(), seed=4)
+    # targets held at each observation (after initial convergence)
+    assert all(len(s) == 2 for s in seen[1:])
+    # rotation happened: not always the same hot set
+    assert len(set(seen)) >= 3, seen
+    churns = [t for t in gov.trace if t[1] == "churn"]
+    assert len(churns) >= 5
+
+
+def test_governor_no_oscillation_at_steady_state():
+    """Once targets are met and nothing fails, the governor makes NO
+    further promote/demote decisions (PeerSelection/Test.hs no-oscillation
+    property)."""
+    targets = PeerSelectionTargets(4, 3, 2)
+    acts = _ScriptedActions([f"p{i}" for i in range(4)])
+    gov = PeerSelectionGovernor(targets, acts, seed=5, retry_interval=1.0)
+
+    async def main():
+        h = sim.spawn(gov.run(), label="governor")
+        await sim.sleep(20.0)            # converge
+        mark = len(gov.trace)
+        await sim.sleep(60.0)            # steady window
+        h.cancel()
+        return [t for t in gov.trace[mark:]
+                if t[1] not in ("request-more-peers",)]
+
+    late = sim.run(main(), seed=5)
+    assert late == [], f"oscillation: {late}"
+
+
+def test_targets_hold_under_repeated_failures():
+    """Random peer failures: suspended peers back off, replacements are
+    promoted, and targets re-converge after each failure."""
+    import random as _random
+    targets = PeerSelectionTargets(8, 4, 2)
+    acts = _ScriptedActions([f"p{i}" for i in range(8)])
+    gov = PeerSelectionGovernor(targets, acts, seed=6, retry_interval=1.0,
+                                suspend_base=2.0)
+    rng = _random.Random(99)
+
+    async def main():
+        h = sim.spawn(gov.run(), label="governor")
+        await sim.sleep(10.0)
+        for _ in range(6):
+            if gov.established:
+                victim = rng.choice(sorted(gov.established, key=str))
+                gov.report_failure(victim)
+            await sim.sleep(8.0)
+        h.cancel()
+        return (len(gov.established), len(gov.active),
+                [i.fail_count for i in gov.known.peers.values()])
+
+    est, act, fails = sim.run(main(), seed=6)
+    assert est == 4 and act == 2
+    assert any(f > 0 for f in fails)     # failures were recorded
