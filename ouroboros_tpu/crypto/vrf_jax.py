@@ -235,9 +235,15 @@ def _r_limbs(vks, alphas) -> np.ndarray:
     return limbs
 
 
-def _submit(vks, alphas, proofs, m):
+def _default_runner(*args):
+    return vrf_verify_kernel(*(jnp.asarray(a) for a in args))
+
+
+def _submit(vks, alphas, proofs, m, runner=None):
     """Parse + dispatch one padded batch; returns (device handle, masks,
-    proof rows).  Does not block — callers may pipeline."""
+    proof rows).  Does not block — callers may pipeline.  `runner` swaps
+    the kernel invocation (e.g. parallel.sharded_verify's mesh-sharded
+    variant)."""
     vk_arr, vk_ok = EJ._bytes_rows(vks, 32)
     pf_arr, pf_ok = EJ._bytes_rows(proofs, PROOF_LEN)
     yY, signY, okYc = EJ._decode_compressed(vk_arr)
@@ -248,12 +254,10 @@ def _submit(vks, alphas, proofs, m):
     parse_ok = vk_ok & okYc & gamma_ok & s_ok
     c_rows = np.zeros((m, 32), dtype=np.uint8)
     c_rows[:, :16] = pf_arr[:, 32:48]
-    handle = vrf_verify_kernel(
-        jnp.asarray(yY), jnp.asarray(signY.astype(np.int32)),
-        jnp.asarray(yG), jnp.asarray(signG.astype(np.int32)),
-        jnp.asarray(_r_limbs(vks, alphas)),
-        jnp.asarray(_bits_from_le_rows(c_rows)),
-        jnp.asarray(_bits_from_le_rows(s_rows)))
+    handle = (runner or _default_runner)(
+        yY, signY.astype(np.int32), yG, signG.astype(np.int32),
+        _r_limbs(vks, alphas), _bits_from_le_rows(c_rows),
+        _bits_from_le_rows(s_rows))
     return handle, parse_ok, gamma_ok, s_ok, pf_arr
 
 
@@ -301,13 +305,16 @@ def batch_verify_vrf(vks, alphas, proofs,
     return _finish(handle, parse_ok, gamma_ok, s_ok, pf_arr, n)
 
 
-def _submit_betas(proofs, m):
+def _submit_betas(proofs, m, runner=None):
     """Parse + dispatch a gamma8 batch; returns (handle, decode_ok)."""
     pf_arr, pf_ok = EJ._bytes_rows(proofs, PROOF_LEN)
     yG, signG, okGc = EJ._decode_compressed(pf_arr[:, :32])
     s_ok = EJ._scalar_lt_L(np.ascontiguousarray(pf_arr[:, 48:80]))
-    handle = gamma8_kernel(jnp.asarray(yG),
-                           jnp.asarray(signG.astype(np.int32)))
+    if runner is None:
+        handle = gamma8_kernel(jnp.asarray(yG),
+                               jnp.asarray(signG.astype(np.int32)))
+    else:
+        handle = runner(yG, signG.astype(np.int32))
     return handle, pf_ok & okGc & s_ok
 
 

@@ -10,8 +10,14 @@ reference's IOLike threads.
 from .blockchain_time import BlockchainTime
 from .kernel import BlockForging, NodeKernel, connect_nodes
 from .chain_sync import CandidateState, ChainSyncClientError
+from .run import (
+    NodeHandle, RunNodeArgs, WrongNetworkError, check_db_marker, run_node,
+    was_clean_shutdown,
+)
 
 __all__ = [
     "BlockchainTime", "BlockForging", "NodeKernel", "connect_nodes",
     "CandidateState", "ChainSyncClientError",
+    "NodeHandle", "RunNodeArgs", "WrongNetworkError", "check_db_marker",
+    "run_node", "was_clean_shutdown",
 ]

@@ -8,6 +8,9 @@ chip runs the same branch-free ladder on its shard, with psum aggregation
 over ICI.  No NCCL/MPI analog: collectives are XLA's.
 """
 from .mesh import make_mesh
-from .sharded_verify import build_sharded_verifier, sharded_batch_verify
+from .sharded_verify import (
+    ShardedJaxBackend, build_sharded_verifier, sharded_batch_verify,
+)
 
-__all__ = ["make_mesh", "build_sharded_verifier", "sharded_batch_verify"]
+__all__ = ["ShardedJaxBackend", "make_mesh", "build_sharded_verifier",
+           "sharded_batch_verify"]

@@ -171,12 +171,14 @@ class ChainDB:
         return db
 
     def _initial_chain_selection(self) -> None:
-        """Best volatile candidate from the immutable tip
-        (ChainSel.hs:88-99)."""
+        """Best volatile candidate from the immutable tip, re-run to a
+        fixpoint as invalid blocks surface (ChainSel.hs:88-99; the invalid
+        set is in-memory only, so reopen rediscovers them)."""
         best = self._best_candidate_from(self.current_chain.anchor,
                                          self.current_chain)
         if best:
             self._try_adopt(self.current_chain.anchor, best)
+        self._reselect_fixpoint()
 
     # -- queries --------------------------------------------------------------
     def tip_point(self) -> Point:
@@ -361,7 +363,77 @@ class ChainDB:
                     raise Retry()
             await sim.atomically(wait)
 
+    def _beats_current(self, cand_view) -> bool:
+        """Is `cand_view` strictly preferred over the current chain?  An
+        EMPTY current chain loses to any valid candidate (the bare block-
+        number sentinel of _chain_select_view is not a protocol SelectView
+        and must not reach prefer_candidate)."""
+        if cand_view is None:
+            return False
+        head = self.current_chain.head
+        if head is None:
+            return True
+        cur_view = self.ext_rules.protocol.select_view(
+            getattr(head, "header", head))
+        return self.ext_rules.protocol.prefer_candidate(cur_view, cand_view)
+
+    def _reselect(self) -> bool:
+        """One full re-selection pass: every candidate constructible from
+        the anchor that beats the current chain, tried best-first from its
+        ACTUAL fork point with the current chain.  Returns True if a
+        candidate was adopted."""
+        import functools
+        cur = self.current_chain
+        prefer = self.ext_rules.protocol.prefer_candidate
+        cands = []
+        for path in self._successors_closure(cur.anchor):
+            v = self._candidate_select_view(cur.anchor, path)
+            if self._beats_current(v):
+                cands.append((path, v))
+        cands.sort(key=functools.cmp_to_key(
+            lambda a, b: -1 if prefer(b[1], a[1])
+            else (1 if prefer(a[1], b[1]) else 0)))
+        for path, _v in cands:
+            fork = cur.anchor
+            i = 0
+            for b in path:
+                if cur.contains_point(point_of(b)):
+                    fork = point_of(b)
+                    i += 1
+                else:
+                    break
+            if i < len(path) and self._try_adopt(fork, path[i:]):
+                return True
+        return False
+
+    def _reselect_fixpoint(self) -> bool:
+        """Re-run selection until the invalid set stops growing: marking a
+        block invalid during validation changes the ranking, so a losing
+        candidate may now win (ChainSel.hs re-triage with the updated
+        invalid set).  Returns True if any adoption happened."""
+        adopted = False
+        for _ in range(64):              # each retry marks >= 1 new invalid
+            before = len(self.invalid)
+            adopted = self._reselect() or adopted
+            if len(self.invalid) == before:
+                break
+        return adopted
+
     def _chain_selection_for(self, block: Any) -> AddBlockResult:
+        before_invalid = len(self.invalid)
+        result = self._triage_once(block)
+        # only a GROWN invalid set can change the candidate ranking; the
+        # common extend/store path skips the full re-selection entirely
+        if len(self.invalid) == before_invalid:
+            return result
+        if self._reselect_fixpoint() and result.kind in ("stored",
+                                                         "invalid"):
+            return AddBlockResult("switched", self.tip_point())
+        if result.kind in ("extended", "switched"):
+            return AddBlockResult(result.kind, self.tip_point())
+        return result
+
+    def _triage_once(self, block: Any) -> AddBlockResult:
         cur = self.current_chain
         tip = self.tip_point()
         if block.prev_hash == (tip.hash if not tip.is_genesis
@@ -374,7 +446,6 @@ class ChainDB:
             return AddBlockResult(kind, self.tip_point())
         # triage 2: reachable from some point on the current fragment?
         import functools
-        cur_view = self._chain_select_view(cur)
         prefer = self.ext_rules.protocol.prefer_candidate
         # the same candidate head is reachable from several fork points
         # (deeper forks re-walk the current chain) — keep, per head, the
@@ -383,7 +454,7 @@ class ChainDB:
         cache: dict = {block.hash: block}
         for fork_point, blocks in self._candidates_through(block, cache):
             cand_view = self._candidate_select_view(fork_point, blocks)
-            if cand_view is None or not prefer(cur_view, cand_view):
+            if not self._beats_current(cand_view):
                 continue
             head = blocks[-1].hash
             depth = self._rollback_depth(fork_point)
@@ -474,13 +545,15 @@ class ChainDB:
 
     def _best_candidate_from(self, point: Point,
                              cur: AnchoredFragment) -> Optional[list]:
-        best, best_view = None, self._chain_select_view(cur)
+        best, best_view = None, None
         for path in self._successors_closure(point):
             v = self._candidate_select_view(point, path)
             if v is None:
                 continue
-            if best is None or self.ext_rules.protocol.prefer_candidate(
-                    best_view, v):
+            if best is None:
+                if self._beats_current(v):
+                    best, best_view = path, v
+            elif self.ext_rules.protocol.prefer_candidate(best_view, v):
                 best, best_view = path, v
         return best
 
@@ -510,10 +583,7 @@ class ChainDB:
         # does the valid prefix still beat the current chain?
         if n_rollback > 0 or res.n_valid < len(blocks):
             cand_view = self._candidate_select_view(fork_point, valid_blocks)
-            cur_view = self._chain_select_view(self.current_chain)
-            if cand_view is None or not \
-                    self.ext_rules.protocol.prefer_candidate(cur_view,
-                                                             cand_view):
+            if not self._beats_current(cand_view):
                 return False
         elif not valid_blocks:
             return False

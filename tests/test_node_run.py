@@ -1,0 +1,111 @@
+"""Node orchestration (node/run.py): run() assembly, DbMarker network
+guard, clean-shutdown marker -> validation policy.
+
+Reference: Node.hs:203-301 runWith, Node/DbMarker.hs, Node/Recovery.hs:6-50
+(crash => absent marker => deep validation on reopen).
+"""
+import pytest
+
+from ouroboros_tpu import simharness as sim
+from ouroboros_tpu.node import (
+    BlockchainTime, BlockForging, RunNodeArgs, WrongNetworkError, run_node,
+    was_clean_shutdown,
+)
+from ouroboros_tpu.storage import MockFS
+from ouroboros_tpu.testing.threadnet import (
+    PraosNetworkFactory, ThreadNetConfig,
+)
+
+
+def _args(factory, fs, i=0, magic=0):
+    from ouroboros_tpu.consensus.ledger import ExtLedgerRules
+    from ouroboros_tpu.consensus.protocols.praos import (
+        HotKey, Praos, praos_forge_fields,
+    )
+    from ouroboros_tpu.crypto import kes as kes_mod
+    from ouroboros_tpu.ledgers.mock import MockLedger, Tx
+
+    cfg = factory.cfg
+    protocol = Praos(factory.protocol_cfg)
+    ledger = MockLedger(factory.genesis)
+    hot_key = HotKey(kes_mod.KesSignKey(cfg.kes_depth,
+                                        factory.keys[i].kes_seed))
+    forging = BlockForging(
+        issuer=i, can_be_leader=(i, factory.keys[i].vrf_sk),
+        forge=lambda protocol, proof, hdr, hk=hot_key:
+            praos_forge_fields(protocol, hk, proof, hdr))
+    return RunNodeArgs(
+        fs=fs, ext_rules=ExtLedgerRules(protocol, ledger),
+        encode_state=factory.enc_state, decode_state=factory.dec_state,
+        block_decode=factory.block_decode,
+        btime=BlockchainTime(cfg.slot_length), forgings=[forging],
+        label=f"run{i}", network_magic=magic, backend=factory.backend,
+        header_decode=factory.header_decode_obj,
+        block_decode_obj=factory.block_decode_obj, tx_decode=Tx.decode,
+        chunk_size=5)
+
+
+def test_clean_shutdown_then_fast_reopen():
+    cfg = ThreadNetConfig(n_nodes=1, n_slots=20, k=3, f=1.0, seed=31)
+    factory = PraosNetworkFactory(cfg)
+    fs = MockFS()
+
+    async def main():
+        h = run_node(_args(factory, fs))
+        assert h.deep_validated          # first open: no marker yet
+        await sim.sleep(10.0)
+        bn = h.kernel.chain_db.current_chain.head_block_no
+        assert bn >= 5
+        h.stop()
+        assert was_clean_shutdown(fs)
+        # clean reopen: fast path (no chunk revalidation)
+        h2 = run_node(_args(factory, fs))
+        assert not h2.deep_validated
+        assert h2.kernel.chain_db.current_chain.head_block_no >= bn
+        h2.stop()
+        return True
+
+    assert sim.run(main(), seed=31)
+
+
+def test_crash_triggers_deep_validation_and_truncates_corruption():
+    cfg = ThreadNetConfig(n_nodes=1, n_slots=20, k=3, f=1.0, seed=32)
+    factory = PraosNetworkFactory(cfg)
+    fs = MockFS()
+
+    async def main():
+        h = run_node(_args(factory, fs))
+        await sim.sleep(12.0)
+        bn = h.kernel.chain_db.current_chain.head_block_no
+        # CRASH: kill threads without writing the marker
+        h.kernel.stop()
+        assert not was_clean_shutdown(fs)
+        # corrupt the immutable store mid-chunk (what a torn write leaves)
+        chunk = ("immutable", "00000.chunk")
+        raw = bytearray(fs.read_file(chunk))
+        raw[len(raw) // 2] ^= 0xFF
+        fs.write_file(chunk, bytes(raw))
+        # reopen: crash => deep validation => corruption truncated, the
+        # node still comes up on the valid prefix
+        h2 = run_node(_args(factory, fs))
+        assert h2.deep_validated
+        assert h2.kernel.chain_db.current_chain.head_block_no <= bn
+        h2.stop()
+        return True
+
+    assert sim.run(main(), seed=32)
+
+
+def test_db_marker_rejects_wrong_network():
+    cfg = ThreadNetConfig(n_nodes=1, n_slots=10, k=3, f=1.0, seed=33)
+    factory = PraosNetworkFactory(cfg)
+    fs = MockFS()
+
+    async def main():
+        h = run_node(_args(factory, fs, magic=7))
+        h.stop()
+        with pytest.raises(WrongNetworkError):
+            run_node(_args(factory, fs, magic=8))
+        return True
+
+    assert sim.run(main(), seed=33)

@@ -35,3 +35,66 @@ def test_sharded_pads_to_mesh_divisible():
     sig = ed25519_ref.sign(sk, b"z")
     assert sharded_batch_verify([vk] * 3, [b"z"] * 3, [sig] * 3, mesh) \
         == [True] * 3
+
+
+def test_sharded_backend_mixed_window_parity():
+    """ShardedJaxBackend verifies a mixed Ed25519+VRF+KES request list
+    over the 8-device mesh with results identical to the host reference —
+    uneven (non-multiple-of-mesh) batch sizes included."""
+    import hashlib
+
+    from ouroboros_tpu.crypto import ed25519_ref, kes, vrf_ref
+    from ouroboros_tpu.crypto.backend import (
+        CpuRefBackend, Ed25519Req, KesReq, VrfReq,
+    )
+    from ouroboros_tpu.parallel import ShardedJaxBackend, make_mesh
+
+    mesh = make_mesh(8)
+    sb = ShardedJaxBackend(mesh, min_bucket=16)
+    ref = CpuRefBackend()
+
+    sk = hashlib.sha256(b"shard-mixed").digest()
+    vk = ed25519_ref.public_key(sk)
+    vsk = hashlib.sha256(b"shard-vrf").digest()
+    vvk = vrf_ref.public_key(vsk)
+    ksk = kes.KesSignKey(2, hashlib.sha256(b"shard-kes").digest())
+
+    reqs = []
+    for i in range(11):                     # deliberately uneven
+        m = b"m%d" % i
+        reqs.append(Ed25519Req(vk, m, ed25519_ref.sign(sk, m)))
+        reqs.append(VrfReq(vvk, m, vrf_ref.prove(vsk, m)))
+        reqs.append(KesReq(2, ksk.verification_key, 0, m,
+                           ksk.sign(m).to_bytes()))
+    # tamper one of each kind
+    reqs[0] = Ed25519Req(vk, b"m0", b"\x00" * 64)
+    bad_vrf = bytearray(reqs[4].proof)
+    bad_vrf[70] ^= 1
+    reqs[4] = VrfReq(vvk, b"m1", bytes(bad_vrf))
+    got = sb.verify_mixed(reqs)
+    want = ref.verify_mixed(reqs)
+    assert got == want
+    assert not got[0] and not got[4] and sum(got) == len(reqs) - 2
+
+
+def test_sharded_ed25519_thousands_of_proofs():
+    """Scale check: 4096 signatures over the 8-device mesh, all accepted,
+    one tampered entry localized correctly."""
+    import hashlib
+
+    from ouroboros_tpu.crypto import ed25519_ref
+    from ouroboros_tpu.parallel import make_mesh, sharded_batch_verify
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    mesh = make_mesh(8)
+    sk = hashlib.sha256(b"shard-scale").digest()
+    key = Ed25519PrivateKey.from_private_bytes(sk)
+    vk = ed25519_ref.public_key(sk)
+    n = 4096
+    msgs = [b"blk-%05d" % i for i in range(n)]
+    sigs = [key.sign(m) for m in msgs]
+    sigs[2049] = sigs[2049][:20] + b"\x00" + sigs[2049][21:]
+    got = sharded_batch_verify([vk] * n, msgs, sigs, mesh)
+    assert got == [i != 2049 for i in range(n)]
