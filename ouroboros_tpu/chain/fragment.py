@@ -79,9 +79,16 @@ class AnchoredFragment(Generic[B]):
         return [point_of(b) for b in reversed(self._blocks)] + [self.anchor]
 
     def select_points(self, offsets: Sequence[int]) -> list[Point]:
-        """Points at the given offsets back from the head (0 = head)."""
-        pts = self.points()
-        return [pts[o] for o in offsets if o < len(pts)]
+        """Points at the given offsets back from the head (0 = head) —
+        O(len(offsets)), not O(fragment)."""
+        n = len(self._blocks)
+        out = []
+        for o in offsets:
+            if o < n:
+                out.append(point_of(self._blocks[n - 1 - o]))
+            elif o == n:
+                out.append(self.anchor)
+        return out
 
     # -- modification --------------------------------------------------------
     def add_block(self, b: B) -> None:
@@ -142,12 +149,14 @@ class AnchoredFragment(Generic[B]):
 
     # -- comparisons ---------------------------------------------------------
     def intersect(self, other: "AnchoredFragment[B]") -> Optional[Point]:
-        """Most recent common point, or None if unrelated."""
-        mine = {self.anchor.hash} | set(self._index)
+        """Most recent common point, or None if unrelated.  Probes the
+        hash index directly — no per-call set construction."""
         for b in reversed(other._blocks):
-            if b.hash in mine:
+            if b.hash in self._index or b.hash == self.anchor.hash:
                 return point_of(b)
-        if other.anchor.hash in mine or other.anchor == self.anchor:
+        if other.anchor.hash in self._index \
+                or other.anchor.hash == self.anchor.hash \
+                or other.anchor == self.anchor:
             return other.anchor
         return None
 

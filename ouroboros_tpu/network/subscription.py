@@ -3,8 +3,10 @@
 Reference: ouroboros-network-framework/src/Ouroboros/Network/Subscription/
 Worker.hs:207-233 (`worker`/`subscriptionLoop`: keep `valency` live
 connections from a target list, redialling as they fail), Ip.hs:66-89 (IP
-targets), PeerState.hs (per-peer suspension state consulted before
-dialling), with ErrorPolicy verdicts driving the suspensions.
+targets), Dns.hs:239-292 (name resolution + the A/AAAA race: both address
+families resolve concurrently and the first usable answer wins, the loser
+is kept as fallback), PeerState.hs (per-peer suspension state consulted
+before dialling), with ErrorPolicy verdicts driving the suspensions.
 
 The dial function abstracts the transport (in-sim kernel dialling here;
 a socket Snocket plugs into the same seam).
@@ -16,6 +18,129 @@ from typing import Callable, Dict, Optional, Sequence
 
 from .. import simharness as sim
 from .error_policy import ErrorPolicy, eval_error_policies
+
+
+class Resolver:
+    """Name resolution seam (Dns.hs `Resolver`): resolve_a/resolve_aaaa
+    return address lists for one family.  Implementations: a dict-backed
+    sim resolver below; a getaddrinfo-backed one for the IO runtime."""
+
+    async def resolve_a(self, name: str) -> list:
+        return []
+
+    async def resolve_aaaa(self, name: str) -> list:
+        return []
+
+
+class DictResolver(Resolver):
+    """Deterministic resolver for sim tests: {name: (a_list, aaaa_list)}
+    with optional per-family artificial latency."""
+
+    def __init__(self, table: Dict[str, tuple], a_delay: float = 0.0,
+                 aaaa_delay: float = 0.0):
+        self.table = dict(table)
+        self.a_delay = a_delay
+        self.aaaa_delay = aaaa_delay
+
+    async def resolve_a(self, name: str) -> list:
+        if self.a_delay:
+            await sim.sleep(self.a_delay)
+        return list(self.table.get(name, ((), ()))[0])
+
+    async def resolve_aaaa(self, name: str) -> list:
+        if self.aaaa_delay:
+            await sim.sleep(self.aaaa_delay)
+        return list(self.table.get(name, ((), ()))[1])
+
+
+class GetAddrInfoResolver(Resolver):
+    """IO-runtime resolver over the system's getaddrinfo."""
+
+    def __init__(self, port: int):
+        self.port = port
+
+    async def _resolve(self, name: str, family) -> list:
+        import asyncio
+        import socket
+        loop = asyncio.get_event_loop()
+        try:
+            infos = await loop.getaddrinfo(name, self.port, family=family,
+                                           type=socket.SOCK_STREAM)
+        except OSError:
+            return []
+        # normalise sockaddrs to the (host, port) shape every dial path
+        # consumes (AF_INET6 sockaddrs carry flowinfo/scopeid extras)
+        return [(info[4][0], info[4][1]) for info in infos]
+
+    async def resolve_a(self, name: str) -> list:
+        import socket
+        return await self._resolve(name, socket.AF_INET)
+
+    async def resolve_aaaa(self, name: str) -> list:
+        import socket
+        return await self._resolve(name, socket.AF_INET6)
+
+
+async def resolve_racing(resolver: Resolver, name: str,
+                         prefer_delay: float = 0.05) -> list:
+    """The Dns.hs A/AAAA race: both lookups run concurrently and the
+    FIRST usable answer wins — a hung family cannot stall dialling.  After
+    the winner arrives, the other family gets `prefer_delay` more to land
+    (AAAA answering within the window still leads, as in the reference);
+    a straggler past the window is dropped, not awaited."""
+    from ..simharness import TQueue
+    answers: TQueue = TQueue(label=f"dns-{name}")
+
+    async def run(tag, coro):
+        addrs = await coro
+
+        def push(tx):
+            answers.put(tx, (tag, addrs))
+        await sim.atomically(push)
+
+    sim.spawn(run("aaaa", resolver.resolve_aaaa(name)),
+              label=f"dns-aaaa-{name}")
+    sim.spawn(run("a", resolver.resolve_a(name)),
+              label=f"dns-a-{name}")
+    got: dict = {}
+    # wait for the first USABLE (non-empty) answer, or both to finish
+    while len(got) < 2:
+        tag, addrs = await sim.atomically(answers.get)
+        got[tag] = addrs
+        if addrs:
+            break
+    if len(got) < 2:
+        done, item = await sim.timeout(prefer_delay,
+                                       sim.atomically(answers.get))
+        if done and item is not None:
+            got[item[0]] = item[1]
+    a6 = got.get("aaaa", [])
+    a4 = got.get("a", [])
+    if a6:
+        return list(a6) + [a for a in a4 if a not in a6]
+    return list(a4)
+
+
+async def dns_subscription_targets(resolver: Resolver, names: Sequence[str],
+                                   prefer_delay: float = 0.05) -> list:
+    """Resolve a root-peer name list into a concrete dial-target list
+    (RootPeersDNS's role for the governor/subscription layer).  Names
+    resolve CONCURRENTLY — wall clock is bounded by the slowest single
+    lookup, not the sum."""
+    results: dict = {}
+
+    async def one(name):
+        results[name] = await resolve_racing(resolver, name, prefer_delay)
+
+    handles = [sim.spawn(one(n), label=f"dns-targets-{n}") for n in names]
+    for h in handles:
+        await h.wait()
+    out: list = []
+    for name in names:
+        for addr in results.get(name, []):
+            if addr not in out:
+                out.append(addr)
+    return out
 
 
 @dataclass

@@ -37,7 +37,14 @@ class WrongNetworkError(Exception):
 def check_db_marker(fs: FsApi, network_magic: int) -> None:
     """Create-or-verify the magic marker (DbMarker.hs lockDbMarkerFile)."""
     if fs.exists(MARKER_FILE):
-        found = int(fs.read_file(MARKER_FILE).decode().strip())
+        raw = fs.read_file(MARKER_FILE)
+        try:
+            found = int(raw.decode().strip())
+        except (UnicodeDecodeError, ValueError) as e:
+            raise WrongNetworkError(
+                f"DB marker is corrupt ({raw[:32]!r}); refusing to open "
+                f"— remove it only if this DB really is for magic "
+                f"{network_magic}") from e
         if found != network_magic:
             raise WrongNetworkError(
                 f"DB marker has magic {found}, node runs {network_magic}")

@@ -174,8 +174,7 @@ class ChainDB:
         """Best volatile candidate from the immutable tip, re-run to a
         fixpoint as invalid blocks surface (ChainSel.hs:88-99; the invalid
         set is in-memory only, so reopen rediscovers them)."""
-        best = self._best_candidate_from(self.current_chain.anchor,
-                                         self.current_chain)
+        best = self._best_candidate_from(self.current_chain.anchor)
         if best:
             self._try_adopt(self.current_chain.anchor, best)
         self._reselect_fixpoint()
@@ -366,7 +365,7 @@ class ChainDB:
     def _beats_current(self, cand_view) -> bool:
         """Is `cand_view` strictly preferred over the current chain?  An
         EMPTY current chain loses to any valid candidate (the bare block-
-        number sentinel of _chain_select_view is not a protocol SelectView
+        number sentinel of an empty fragment is not a protocol SelectView
         and must not reach prefer_candidate)."""
         if cand_view is None:
             return False
@@ -412,12 +411,11 @@ class ChainDB:
         candidate may now win (ChainSel.hs re-triage with the updated
         invalid set).  Returns True if any adoption happened."""
         adopted = False
-        for _ in range(64):              # each retry marks >= 1 new invalid
-            before = len(self.invalid)
+        while True:                      # each retry marks >= 1 new invalid
+            before = len(self.invalid)   # block, so bounded by volatile size
             adopted = self._reselect() or adopted
             if len(self.invalid) == before:
-                break
-        return adopted
+                return adopted
 
     def _chain_selection_for(self, block: Any) -> AddBlockResult:
         before_invalid = len(self.invalid)
@@ -440,7 +438,7 @@ class ChainDB:
                                else GENESIS_HASH):
             # triage 1: extends the current tip — adopt the best path
             # through it (picks up already-stored successors too)
-            best = self._best_candidate_from(tip, cur)
+            best = self._best_candidate_from(tip)
             ok = self._try_adopt(tip, best if best else [block])
             kind = "extended" if ok else "invalid"
             return AddBlockResult(kind, self.tip_point())
@@ -473,13 +471,6 @@ class ChainDB:
                 return AddBlockResult("switched", self.tip_point())
         return AddBlockResult("stored", self.tip_point())
 
-    def _chain_select_view(self, chain: AnchoredFragment):
-        head = chain.head
-        if head is None:
-            return chain.anchor_block_no if chain.anchor_block_no >= 0 \
-                else -1
-        return self.ext_rules.protocol.select_view(
-            getattr(head, "header", head))
 
     def _candidate_select_view(self, fork_point: Point, blocks: Sequence):
         if not blocks:
@@ -543,8 +534,7 @@ class ChainDB:
                     cands.append((p, path))
         return cands
 
-    def _best_candidate_from(self, point: Point,
-                             cur: AnchoredFragment) -> Optional[list]:
+    def _best_candidate_from(self, point: Point) -> Optional[list]:
         best, best_view = None, None
         for path in self._successors_closure(point):
             v = self._candidate_select_view(point, path)

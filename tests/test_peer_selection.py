@@ -340,3 +340,72 @@ def test_targets_hold_under_repeated_failures():
     est, act, fails = sim.run(main(), seed=6)
     assert est == 4 and act == 2
     assert any(f > 0 for f in fails)     # failures were recorded
+
+
+# ---------------------------------------------------------------------------
+# DNS resolution + A/AAAA racing (Subscription/Dns.hs:239-292)
+# ---------------------------------------------------------------------------
+
+def test_dns_race_prefers_fast_aaaa():
+    from ouroboros_tpu.network.subscription import (
+        DictResolver, resolve_racing,
+    )
+
+    async def main():
+        r = DictResolver({"relay": (["1.2.3.4"], ["::1", "::2"])},
+                         a_delay=0.01, aaaa_delay=0.02)
+        return await resolve_racing(r, "relay", prefer_delay=0.05)
+
+    addrs = sim.run(main())
+    # AAAA answered within the preference window: v6 leads, v4 fallback
+    assert addrs == ["::1", "::2", "1.2.3.4"]
+
+
+def test_dns_race_falls_back_to_a_when_aaaa_slow_or_empty():
+    from ouroboros_tpu.network.subscription import (
+        DictResolver, resolve_racing,
+    )
+
+    async def main():
+        slow6 = DictResolver({"relay": (["1.2.3.4"], ["::1"])},
+                             a_delay=0.0, aaaa_delay=1.0)
+        first = await resolve_racing(slow6, "relay", prefer_delay=0.05)
+        no6 = DictResolver({"relay": (["5.6.7.8"], [])})
+        second = await resolve_racing(no6, "relay")
+        return first, second
+
+    first, second = sim.run(main())
+    # slow AAAA loses the race AND misses the preference window: it is
+    # dropped rather than awaited (a hung family must not stall dialling)
+    assert first == ["1.2.3.4"]
+    assert second == ["5.6.7.8"]
+
+
+def test_dns_targets_feed_subscription_worker():
+    """Resolved names become the worker's dial targets; valency held."""
+    from ouroboros_tpu.network.subscription import (
+        DictResolver, SubscriptionWorker, dns_subscription_targets,
+    )
+
+    dialled = []
+
+    def dial(addr):
+        dialled.append(addr)
+
+        async def conn():
+            await sim.sleep(100.0)
+        return sim.spawn(conn(), label=f"conn-{addr}")
+
+    async def main():
+        r = DictResolver({"relay1": (["10.0.0.1"], ["fd::1"]),
+                          "relay2": (["10.0.0.2"], [])})
+        targets = await dns_subscription_targets(r, ["relay1", "relay2"])
+        w = SubscriptionWorker(targets, valency=2, dial=dial)
+        h = sim.spawn(w.run(), label="worker")
+        await sim.sleep(5.0)
+        h.cancel()
+        return targets
+
+    targets = sim.run(main())
+    assert set(targets) == {"fd::1", "10.0.0.1", "10.0.0.2"}
+    assert len(dialled) == 2            # valency respected

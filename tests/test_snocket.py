@@ -1,0 +1,159 @@
+"""Snocket transport abstraction: same dial/serve code over in-sim
+bearers, TCP, and Unix sockets; ConnectionTable; accept rate limiting;
+the ping demo tool.
+
+Reference surfaces: Snocket.hs:163-214, Server/ConnectionTable.hs,
+Server/RateLimiting.hs, network-mux/demo/cardano-ping.hs.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ouroboros_tpu import simharness as sim
+from ouroboros_tpu.network.mux import INITIATOR, RESPONDER, Mux, SDU
+from ouroboros_tpu.network.snocket import (
+    AcceptLimits, ConnectionTable, SimSnocket, SnocketError, TcpSnocket,
+    UnixSnocket, run_server, snocket_for,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+async def _echo_handler(bearer, remote):
+    """SDU-level echo: read one SDU, send it back."""
+    sdu = await bearer.read()
+    await bearer.write(SDU(0, sdu.mode, sdu.num, sdu.payload))
+
+
+async def _dial_echo(snocket, addr, payload=b"hello"):
+    bearer = await snocket.connect(addr)
+    await bearer.write(SDU(0, 0, 2, payload))
+    back = await bearer.read()
+    return back.payload
+
+
+def test_sim_snocket_dial_serve():
+    sn = SimSnocket()
+
+    async def main():
+        lst = await sn.listen("nodeA")
+        sim.spawn(run_server(lst, _echo_handler), label="server")
+        out = await _dial_echo(sn, "nodeA", b"ping-sim")
+        # unknown address refused
+        try:
+            await sn.connect("nowhere")
+            refused = False
+        except SnocketError:
+            refused = True
+        return out, refused
+
+    out, refused = sim.run(main())
+    assert out == b"ping-sim" and refused
+
+
+def test_connection_table_duplicate_refused():
+    table = ConnectionTable()
+    assert table.include("peer1")
+    assert not table.include("peer1")
+    assert len(table) == 1
+    table.remove("peer1")
+    assert table.include("peer1")
+
+
+def test_accept_rate_limiting_paces_accepts():
+    """Above the soft limit every accept is delayed; below it accepts are
+    immediate (RateLimiting.hs)."""
+    sn = SimSnocket()
+    accepted = []
+
+    async def handler(bearer, remote):
+        accepted.append((sim.now(), remote))
+        await sim.sleep(100.0)          # hold the table slot
+
+    async def main():
+        lst = await sn.listen("srv")
+        limits = AcceptLimits(hard_limit=10, soft_limit=2, delay=5.0)
+        sim.spawn(run_server(lst, handler, limits=limits), label="server")
+        for i in range(4):
+            await sn.connect("srv")
+        await sim.sleep(30.0)
+        return list(accepted)
+
+    acc = sim.run(main())
+    assert len(acc) == 4
+    # first two accepts immediate, later ones paced by the 5s delay
+    assert acc[1][0] - acc[0][0] < 1.0
+    assert acc[3][0] - acc[2][0] >= 5.0
+
+
+def test_snocket_for_dispatch():
+    sn = SimSnocket()
+    assert isinstance(snocket_for(("127.0.0.1", 80)), TcpSnocket)
+    assert isinstance(snocket_for("/tmp/x.sock"), UnixSnocket)
+    assert snocket_for("nodeB", sim_registry=sn) is sn
+
+
+def test_tcp_and_unix_snocket_echo(tmp_path):
+    """The SAME dial/serve code over real TCP and Unix sockets (IO
+    runtime)."""
+    from ouroboros_tpu.simharness import io_run
+
+    async def tcp_main():
+        sn = TcpSnocket()
+        lst = await sn.listen(("127.0.0.1", 0))
+        sim.spawn(run_server(lst, _echo_handler), label="tcp-server")
+        await sim.sleep(0.05)
+        return await _dial_echo(sn, lst.addr, b"over-tcp")
+
+    assert io_run(tcp_main()) == b"over-tcp"
+
+    path = str(tmp_path / "node.sock")
+
+    async def unix_main():
+        sn = UnixSnocket()
+        lst = await sn.listen(path)
+        sim.spawn(run_server(lst, _echo_handler), label="unix-server")
+        await sim.sleep(0.05)
+        return await _dial_echo(sn, path, b"over-unix")
+
+    assert io_run(unix_main()) == b"over-unix"
+
+
+def test_ping_tool_against_served_node(tmp_path):
+    """cardano-ping analog end-to-end: serve a real node over TCP, run
+    tools/ping.py against it, expect negotiated version + RTT stats."""
+    server = subprocess.Popen(
+        [sys.executable, "-c", f"""
+import sys
+sys.path.insert(0, {REPO!r})
+from ouroboros_tpu.simharness import io_run
+from ouroboros_tpu.testing.threadnet import PraosNetworkFactory, ThreadNetConfig
+from ouroboros_tpu.node.socket_net import serve_node
+from ouroboros_tpu import simharness as sim
+
+async def main():
+    factory = PraosNetworkFactory(ThreadNetConfig(n_nodes=1, k=3, f=1.0))
+    kern = factory.make_node(0)
+    srv, port = await serve_node(kern, port=0)
+    print(port, flush=True)
+    await sim.sleep(30.0)
+
+io_run(main())
+"""],
+        stdout=subprocess.PIPE, text=True, cwd=REPO)
+    try:
+        port = int(server.stdout.readline().strip())
+        r = subprocess.run(
+            [sys.executable, "tools/ping.py", "127.0.0.1", str(port),
+             "--count", "3"],
+            capture_output=True, text=True, cwd=REPO, timeout=60)
+        assert r.returncode == 0, r.stderr
+        info = json.loads(r.stdout)
+        assert info["ok"] and info["probes"] == 3
+        assert info["rtt_avg_ms"] >= 0
+        assert info["version"] >= 1
+    finally:
+        server.kill()
