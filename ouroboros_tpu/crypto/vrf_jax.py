@@ -40,32 +40,49 @@ from . import field_jax as F
 from .vrf_ref import PROOF_LEN, SUITE
 
 _GX, _GY = ed.to_affine(ed.BASE)
+# [2^128]B — compile-time constant for the split-scalar ladder
+_B128 = ed.scalar_mult(1 << 128, ed.BASE)
+_G2X, _G2Y = ed.to_affine(_B128)
 _A = ed.A24                           # Montgomery A = 486662
 # reference fallback for the measure-zero Elligator edge case 1+2r^2 == 0:
 # host path yields u = -A, y = (-A-1)/(1-A)
 _Y_W0 = (ed.P - _A - 1) * ed.inv((1 - _A) % ed.P) % ed.P
 
 
-def _dual_ladder_ext(P1, P2, a_bits, b_bits):
-    """Q = [a]P1 + [b]P2 with P1, P2 in full extended coordinates (general
-    Z).  Returns projective (X, Y, Z)."""
+def _double_n(pt, n_doublings: int):
+    return jax.lax.fori_loop(0, n_doublings,
+                             lambda _, p: EJ.pt_double(p), pt)
+
+
+def _triple_ladder_128(P1, P1p, P2, lo_bits, hi_bits, c_bits):
+    """Q = [lo]P1 + [hi]P1' + [c]P2 in 128 iterations (all three scalars
+    are < 2^128: the verification scalar s splits as s = hi*2^128 + lo
+    with P1' = [2^128]P1, and the VRF challenge c is 16 bytes).  Halves
+    the doubling chain of the naive 256-iteration dual ladder.  Points in
+    full extended coordinates; returns projective (X, Y, Z)."""
     n = P1[0].shape[1]
-    T3 = EJ.pt_add(P1, P2, n)
+    # 8-entry table over bit combinations (lo + 2*hi + 4*c)
     ident = EJ._identity_like(P1[0])
-    table = tuple(jnp.stack([ident[c], P1[c], P2[c], T3[c]])
+    t3 = EJ.pt_add(P1, P1p, n)
+    t5 = EJ.pt_add(P1, P2, n)
+    t6 = EJ.pt_add(P1p, P2, n)
+    t7 = EJ.pt_add(t3, P2, n)
+    table = tuple(jnp.stack([ident[c], P1[c], P1p[c], t3[c],
+                             P2[c], t5[c], t6[c], t7[c]])
                   for c in range(4))
 
     def body(i, Q):
         Q = EJ.pt_double(Q)
-        ab = jax.lax.dynamic_index_in_dim(a_bits, i, 0, keepdims=False)
-        bb = jax.lax.dynamic_index_in_dim(b_bits, i, 0, keepdims=False)
-        idx = ab + 2 * bb
-        sel = (idx[None, :] == jnp.arange(4, dtype=jnp.int32)[:, None])
+        lo = jax.lax.dynamic_index_in_dim(lo_bits, i, 0, keepdims=False)
+        hi = jax.lax.dynamic_index_in_dim(hi_bits, i, 0, keepdims=False)
+        cb = jax.lax.dynamic_index_in_dim(c_bits, i, 0, keepdims=False)
+        idx = lo + 2 * hi + 4 * cb
+        sel = (idx[None, :] == jnp.arange(8, dtype=jnp.int32)[:, None])
         sel = sel.astype(jnp.int32)[:, None, :]
         entry = tuple(jnp.sum(table[c] * sel, axis=0) for c in range(4))
         return EJ.pt_add(Q, entry, n)
 
-    Q = jax.lax.fori_loop(0, 256, body, ident)
+    Q = jax.lax.fori_loop(0, 128, body, ident)
     return Q[0], Q[1], Q[2]
 
 
@@ -156,7 +173,7 @@ def compress_device(x_aff, y_aff):
     return byts.at[31].add(sign << 7)
 
 
-def vrf_verify_core(yY, signY, yG, signG, r, c_bits, s_bits):
+def vrf_verify_core(yY, signY, yG, signG, r, c_bits, s_lo_bits, s_hi_bits):
     """Full device half of batched VRF verification.
 
     Returns an (N, 130) uint8 array per item:
@@ -170,18 +187,26 @@ def vrf_verify_core(yY, signY, yG, signG, r, c_bits, s_bits):
     xG, okG = EJ.device_decompress(yG, signG)
     H = _double3(elligator2_fraction(r))             # cofactor clearing
     G8 = _double3((xG, yG, one, F.mul(xG, yG)))      # for beta
-    # ladder halves: U = [s]B + [c](-Y),  V = [s]H + [c](-Gamma)
+    # ladder halves, split-scalar form (s = hi*2^128 + lo, c < 2^128):
+    #   U = [lo]B + [hi]B' + [c](-Y)     with B' = [2^128]B (constant)
+    #   V = [lo]H + [hi]H' + [c](-Gamma) with H' = [2^128]H (128 doubles)
     nYx = F.sub(yY * 0, xY)
     nGx = F.sub(yG * 0, xG)
     B = (F.const_batch(_GX, n), F.const_batch(_GY, n), one,
          F.const_batch(_GX * _GY % ed.P, n))
+    Bp = (F.const_batch(_G2X, n), F.const_batch(_G2Y, n), one,
+          F.const_batch(_G2X * _G2Y % ed.P, n))
+    Hp = _double_n(H, 128)
     negY = (nYx, yY, one, F.mul(nYx, yY))
     negG = (nGx, yG, one, F.mul(nGx, yG))
     P1 = tuple(jnp.concatenate([B[c], H[c]], axis=1) for c in range(4))
-    P2 = tuple(jnp.concatenate([negY[c], negG[c]], axis=1) for c in range(4))
-    abits = jnp.concatenate([s_bits, s_bits], axis=1)
-    bbits = jnp.concatenate([c_bits, c_bits], axis=1)
-    UV = _dual_ladder_ext(P1, P2, abits, bbits)
+    P1p = tuple(jnp.concatenate([Bp[c], Hp[c]], axis=1) for c in range(4))
+    P2 = tuple(jnp.concatenate([negY[c], negG[c]], axis=1)
+               for c in range(4))
+    lo2 = jnp.concatenate([s_lo_bits, s_lo_bits], axis=1)
+    hi2 = jnp.concatenate([s_hi_bits, s_hi_bits], axis=1)
+    c2 = jnp.concatenate([c_bits, c_bits], axis=1)
+    UV = _triple_ladder_128(P1, P1p, P2, lo2, hi2, c2)
     # one inversion chain for every Z: [H | U | V | G8]
     Zall = jnp.concatenate([H[2], UV[2], G8[2]], axis=1)      # (NLIMBS, 4n)
     Zi = EJ.pow_inv(Zall)
@@ -216,8 +241,9 @@ def gamma8_kernel(yG, signG):
 # Host orchestration
 # ---------------------------------------------------------------------------
 
-def _bits_from_le_rows(rows: np.ndarray) -> np.ndarray:
-    """(N, 32) little-endian scalar bytes -> (256, N) MSB-first int32 bits."""
+def _bits128_from_le(rows: np.ndarray) -> np.ndarray:
+    """(N, 16) little-endian scalar bytes -> (128, N) MSB-first int32
+    bits (one 128-bit ladder half)."""
     bits = np.flip(np.unpackbits(rows, axis=1, bitorder="little"), axis=1)
     return np.ascontiguousarray(bits.T).astype(np.int32)
 
@@ -252,12 +278,12 @@ def _submit(vks, alphas, proofs, m, runner=None):
     s_ok = EJ._scalar_lt_L(s_rows)
     gamma_ok = pf_ok & okGc
     parse_ok = vk_ok & okYc & gamma_ok & s_ok
-    c_rows = np.zeros((m, 32), dtype=np.uint8)
-    c_rows[:, :16] = pf_arr[:, 32:48]
     handle = (runner or _default_runner)(
         yY, signY.astype(np.int32), yG, signG.astype(np.int32),
-        _r_limbs(vks, alphas), _bits_from_le_rows(c_rows),
-        _bits_from_le_rows(s_rows))
+        _r_limbs(vks, alphas),
+        _bits128_from_le(np.ascontiguousarray(pf_arr[:, 32:48])),  # c
+        _bits128_from_le(np.ascontiguousarray(s_rows[:, :16])),    # s lo
+        _bits128_from_le(np.ascontiguousarray(s_rows[:, 16:])))    # s hi
     return handle, parse_ok, gamma_ok, s_ok, pf_arr
 
 

@@ -79,16 +79,17 @@ def sharded_batch_verify(vks, msgs, sigs, mesh: Mesh,
 @functools.lru_cache(maxsize=8)
 def build_sharded_vrf(mesh: Mesh):
     """shard_map of crypto.vrf_jax.vrf_verify_core over the window axis:
-    each device decompresses, maps Elligator2, and runs the dual ladders on
-    its shard of the VRF batch — no cross-device communication (the proofs
-    are independent), so throughput scales linearly over ICI."""
+    each device decompresses, maps Elligator2, and runs the split-scalar
+    128-iteration ladders on its shard of the VRF batch — no cross-device
+    communication (the proofs are independent), so throughput scales
+    linearly over ICI."""
     from ..crypto import vrf_jax
     axis = mesh.axis_names[0]
     spec2 = P(None, axis)
     spec1 = P(axis)
     mapped = jax.shard_map(
         vrf_jax.vrf_verify_core, mesh=mesh,
-        in_specs=(spec2, spec1, spec2, spec1, spec2, spec2, spec2),
+        in_specs=(spec2, spec1, spec2, spec1, spec2, spec2, spec2, spec2),
         out_specs=P(axis, None))
     return jax.jit(mapped)
 
@@ -143,7 +144,7 @@ class ShardedJaxBackend(CryptoBackend):
         axis = self.mesh.axis_names[0]
         s2 = NamedSharding(self.mesh, P(None, axis))
         s1 = NamedSharding(self.mesh, P(axis))
-        specs = (s2, s1, s2, s1, s2, s2, s2)
+        specs = (s2, s1, s2, s1, s2, s2, s2, s2)
 
         def run(*args):
             return fn(*(jax.device_put(np.asarray(a), s)

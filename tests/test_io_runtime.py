@@ -120,7 +120,7 @@ def test_two_nodes_sync_over_real_sockets():
         server_b, port_b = await serve_node(b)
         dial_node(a, "127.0.0.1", port_b)
         dial_node(b, "127.0.0.1", port_a)
-        await sim.sleep(20 * 0.1 + 0.5)
+        await sim.sleep(cfg.n_slots * cfg.slot_length + 0.5)
         chains = [a.chain_db.current_chain.copy(),
                   b.chain_db.current_chain.copy()]
         a.stop()
