@@ -154,6 +154,12 @@ def validate_blocks_batched(
     for i, b in enumerate(blocks):
         try:
             reqs, st = _seq_block_step(protocol, ledger, st, b)
+        except OutsideForecastRange as e:
+            # not a validation failure: the caller must retry once the
+            # chain advances (the reference never marks such a block
+            # invalid — same special case as validate_headers_batched)
+            seq_error = e
+            break
         except Exception as e:
             seq_error = (e if isinstance(e, (HeaderError, LedgerError))
                          else LedgerError(str(e)))
@@ -183,7 +189,12 @@ def validate_blocks_batched(
 class ReplayResult:
     """Outcome of a pipelined replay: final state only (a mainnet-scale
     replay cannot keep per-block states), global valid-block count, first
-    error."""
+    error.
+
+    On OutsideForecastRange — retry-later, not a validation failure —
+    final_state is the state after the valid prefix, so the caller can
+    resume the replay from there once the chain advances; on a genuine
+    validation failure final_state is None."""
     final_state: Any
     n_valid: int
     error: Optional[Exception]
@@ -240,7 +251,10 @@ def replay_blocks_pipelined(
                                           backend=backend)
             done += res.n_valid
             if not res.all_valid:
-                return ReplayResult(None, done, res.error)
+                resume = (res.final_state or st
+                          if isinstance(res.error, OutsideForecastRange)
+                          else None)
+                return ReplayResult(resume, done, res.error)
             st = res.final_state
         return ReplayResult(st, done, None)
 
@@ -293,6 +307,10 @@ def replay_blocks_pipelined(
         for i, b in enumerate(blk_window):
             try:
                 rs, st = _seq_block_step(protocol, ledger, st, b)
+            except OutsideForecastRange as e:
+                # retry-later, never invalid (see validate_blocks_batched)
+                seq_error = e
+                break
             except Exception as e:
                 seq_error = (e if isinstance(e, (HeaderError, LedgerError))
                              else LedgerError(str(e)))
@@ -322,7 +340,11 @@ def replay_blocks_pipelined(
             err, n_ok = drain(pending)
             if err is not None:
                 return ReplayResult(None, n_ok, err)
-            return ReplayResult(None, done, seq_error)
+            # the valid prefix (incl. this window's drained proofs) is
+            # fully verified: resumable when the error is retry-later
+            resume = (st if isinstance(seq_error, OutsideForecastRange)
+                      else None)
+            return ReplayResult(resume, done, seq_error)
 
     if pending is not None:
         err, n_ok = drain(pending)

@@ -32,6 +32,13 @@ class Channel:
     async def recv(self) -> Any:
         return await sim.atomically(self._in.get)
 
+    async def wait_ready(self, timeout: float) -> bool:
+        """Block until recv() would not block (True) or `timeout` elapses
+        (False) — WITHOUT consuming anything.  The cancellation-free way to
+        poll a possibly-quiescent peer (vs wrapping recv in sim.timeout,
+        which can lose state in the cancelled continuation)."""
+        return await sim.wait_pred(lambda tx: self._in.size(tx) > 0, timeout)
+
 
 def channel_pair(capacity: int = 64, delay: float = 0.0,
                  label: str = "chan") -> Tuple[Channel, Channel]:

@@ -121,6 +121,21 @@ class MuxChannel:
             return buf
         return await sim.atomically(tx_fn)
 
+    async def wait_ready(self, timeout: float) -> bool:
+        """True when ingress bytes are pending, False after `timeout` —
+        non-destructive (see Channel.wait_ready)."""
+        return await sim.wait_pred(
+            lambda tx: bool(tx.read(self.ingress)), timeout)
+
+    async def try_recv(self) -> bytes:
+        """Drain pending ingress bytes without blocking (b"" when none)."""
+        def tx_fn(tx):
+            buf = tx.read(self.ingress)
+            if buf:
+                tx.write(self.ingress, b"")
+            return buf
+        return await sim.atomically(tx_fn)
+
 
 class Mux:
     """The mux proper: fair egress servicing + demux (Mux.hs:176-282)."""
@@ -247,3 +262,24 @@ class CodecChannel:
                     raw, self._buf = self._buf[:used], self._buf[used:]
                     return self._codec.decode(raw)
             self._buf += await self._ch.recv()
+
+    async def wait_ready(self, timeout: float) -> bool:
+        """True when a COMPLETE message is decodable within `timeout`,
+        False otherwise — message-aware, so a peer dribbling a partial
+        frame cannot make the caller's follow-up recv() block unboundedly.
+        Partial bytes are pulled into the channel's own buffer (safe: the
+        buffer survives and the message layer never sees a torn frame)."""
+        from ..utils import cbor
+        deadline = sim.now() + timeout
+        while True:
+            if self._buf:
+                try:
+                    _, used = cbor.loads_prefix(self._buf)
+                    if used:
+                        return True
+                except cbor.CBORTruncated:
+                    pass
+            remaining = deadline - sim.now()
+            if remaining <= 0 or not await self._ch.wait_ready(remaining):
+                return False
+            self._buf += await self._ch.try_recv()

@@ -135,11 +135,21 @@ class PipelinedSession(Session):
         A reply may span several messages (e.g. ChainSync's MsgAwaitReply
         followed by the eventual MsgRollForward): when the state after this
         message still has peer agency, the continuation state goes back to
-        the front of the queue so the next collect() consumes the rest."""
+        the front of the queue so the next collect() consumes the rest.
+
+        Cancellation-safe: the outstanding entry is only consumed AFTER the
+        recv completes, so wrapping collect() in a timeout and cancelling it
+        (e.g. the ChainSync client's horizon-stall poll) leaves the pipeline
+        bookkeeping intact — the reply the server still owes will be matched
+        against the right expected state by the next collect()."""
         if not self._outstanding:
             raise ProtocolError(f"{self.spec.name}: nothing to collect")
-        reply_in_state = self._outstanding.pop(0)
+        reply_in_state = self._outstanding[0]
         msg = await self.channel.recv()
+        # no await between here and the pop: atomic under the cooperative
+        # scheduler, so a single consumer can never double-collect the entry
+        popped = self._outstanding.pop(0)
+        assert popped is reply_in_state
         nxt = self.spec._next(reply_in_state, msg)
         if nxt is None:
             raise ProtocolError(

@@ -138,16 +138,16 @@ async def chain_sync_client(session, kernel, candidate: CandidateState,
         if horizon_stalled[0] and buffered:
             # forecast horizon hit: our own chain must advance (BlockFetch
             # adopting the validated prefix) before the rest validates —
-            # poll with a timeout instead of blocking on the peer, who may
-            # be quiescent at its tip (Client.hs forecast waiting)
-            done, msg = await sim.timeout(0.2, session.collect())
-            if not done:
-                horizon_stalled[0] = False
+            # poll the channel NON-destructively instead of cancelling a
+            # collect() (cancellation would lose pipeline bookkeeping /
+            # in-flight replies) while the peer may be quiescent at its tip
+            # (Client.hs forecast waiting)
+            ready = await session.channel.wait_ready(0.2)
+            horizon_stalled[0] = False
+            if not ready:
                 flush()
                 continue
-            horizon_stalled[0] = False
-        else:
-            msg = await session.collect()
+        msg = await session.collect()
         if isinstance(msg, MsgAwaitReply):
             # caught up: validate what we have, then wait for the next
             # server push (the collect below blocks on the channel)

@@ -97,3 +97,19 @@ def new_timeout(seconds: float):
     if isinstance(rt, Sim):
         return _sim_new_timeout(seconds)
     return rt.new_timeout(seconds)
+
+
+async def wait_pred(pred, timeout: float) -> bool:
+    """Block until `pred(tx)` is true (returns True) or `timeout` elapses
+    (returns False) — one STM transaction, nothing consumed, no task
+    cancellation involved.  The building block for non-destructive channel
+    polling (Channel/MuxChannel.wait_ready)."""
+    tv = new_timeout(timeout)
+
+    def tx_fn(tx):
+        if pred(tx):
+            return True
+        if tx.read(tv):
+            return False
+        retry()
+    return await atomically(tx_fn)

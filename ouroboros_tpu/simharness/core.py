@@ -326,18 +326,27 @@ class Sim:
                 raise interrupt
 
     def _step(self, thread: _Thread):
-        # pending STM re-run takes priority (unless an exception is queued)
+        # a pending cancellation beats a pending STM re-run: the blocked
+        # transaction aborts WITHOUT committing (GHC semantics — an async
+        # exception delivered to a thread blocked in `atomically` rolls the
+        # transaction back), so a message that wakes a recv in the same
+        # instant a timeout fires stays in the queue instead of being
+        # consumed-and-dropped by the cancelled continuation
+        if thread.pending_cancel and not thread.masked \
+                and thread.resume_exc is None:
+            thread.pending_cancel = False
+            thread.stm_tx_fn = None
+            thread.resume_exc = AsyncCancelled()
         if thread.stm_tx_fn is not None and thread.resume_exc is None:
             tx_fn, thread.stm_tx_fn = thread.stm_tx_fn, None
             self._run_stm(thread, tx_fn)
             return
-        if thread.pending_cancel and not thread.masked \
-                and thread.resume_exc is None:
-            thread.pending_cancel = False
-            thread.resume_exc = AsyncCancelled()
         try:
             if thread.resume_exc is not None:
                 exc, thread.resume_exc = thread.resume_exc, None
+                # an exception resume supersedes any pending transaction:
+                # it must not re-run if the coroutine catches and re-blocks
+                thread.stm_tx_fn = None
                 eff = thread.coro.throw(exc)
             else:
                 val, thread.resume_value = thread.resume_value, None

@@ -22,7 +22,9 @@ from typing import Any, Callable, Optional, Sequence
 from ..chain.block import GENESIS_HASH, Point, point_of
 from ..chain.fragment import AnchoredFragment
 from ..consensus.batch import validate_blocks_batched
-from ..consensus.ledger import ExtLedgerRules, ExtLedgerState
+from ..consensus.ledger import (
+    ExtLedgerRules, ExtLedgerState, OutsideForecastRange,
+)
 from .fs import FsApi
 from .immutabledb import ImmutableDB
 from .ledgerdb import DiskPolicy, LedgerDB
@@ -565,7 +567,11 @@ class ChainDB:
         res = validate_blocks_batched(self.ext_rules, list(blocks),
                                       base_state, backend=self.backend)
         valid_blocks = list(blocks)[:res.n_valid]
-        if res.error is not None:
+        if res.error is not None and not isinstance(res.error,
+                                                    OutsideForecastRange):
+            # OutsideForecastRange is retry-later, never invalid: the
+            # reference defers such blocks until the chain advances
+            # (ADVICE r2; cf. ChainSync forecast-horizon waiting)
             for b in list(blocks)[res.n_valid:]:
                 self.invalid[b.hash] = str(res.error)
         if not valid_blocks and n_rollback > 0:

@@ -165,6 +165,9 @@ class ImmutableDB:
         return slot // self.chunk_size
 
     def get_by_slot(self, slot: int) -> Optional[bytes]:
+        """Block bytes at `slot`.  When an EBB shares the slot with its
+        successor, this resolves to the non-EBB block (the real block wins
+        the slot index); use get_by_hash/stream to reach the EBB itself."""
         loc = self._by_slot.get(slot)
         if loc is None:
             return None
@@ -230,7 +233,9 @@ class ImmutableDB:
                 yield e, self.fs.read_range(_chunk_file(n), e.offset, e.size)
 
     def __len__(self) -> int:
-        return len(self._by_slot)
+        # count entries, not slots: an EBB and its successor share a slot
+        # (ADVICE r2), so len(self._by_slot) would undercount by one per EBB
+        return sum(len(c) for c in self._chunks.values())
 
     # -- append ---------------------------------------------------------------
     def append_block(self, slot: int, block_no: int, h: bytes,

@@ -1,0 +1,238 @@
+"""Regression tests for round-2 advisor findings (ADVICE.md r2):
+
+- PipelinedSession.collect() cancellation must not lose the outstanding
+  entry; the ChainSync horizon-stall poll now uses a NON-destructive
+  channel wait (wait_ready) instead of cancelling collect()
+- OutsideForecastRange in the BLOCK validation path is retry-later, never
+  a validation failure — ChainDB must not mark such blocks invalid
+- ImmutableDB.__len__ counts entries, not slots (an EBB and its successor
+  share a slot)
+"""
+import hashlib
+
+import pytest
+
+from ouroboros_tpu import simharness as sim
+from ouroboros_tpu.chain.block import point_of
+from ouroboros_tpu.consensus import ExtLedgerRules
+from ouroboros_tpu.consensus.batch import validate_blocks_batched
+from ouroboros_tpu.consensus.headers import ProtocolBlock, make_header
+from ouroboros_tpu.consensus.ledger import OutsideForecastRange
+from ouroboros_tpu.consensus.protocols import Bft, bft_sign_header
+from ouroboros_tpu.crypto import ed25519_ref
+from ouroboros_tpu.crypto.backend import OpensslBackend
+from ouroboros_tpu.ledgers import MockLedger
+from ouroboros_tpu.network.channel import channel_pair
+from ouroboros_tpu.network.typed import CLIENT, PipelinedSession, ProtocolSpec
+from ouroboros_tpu.storage import ImmutableDB, MockFS
+
+BACKEND = OpensslBackend()
+
+
+# ---------------------------------------------------------------------------
+# collect() cancellation safety + wait_ready polling
+# ---------------------------------------------------------------------------
+
+class MsgReq:
+    pass
+
+
+class MsgResp:
+    pass
+
+
+SPEC = ProtocolSpec(
+    name="reqresp-test",
+    init_state="Idle",
+    agency={"Idle": "client", "Busy": "server"},
+    transitions={("Idle", "MsgReq"): "Busy", ("Busy", "MsgResp"): "Idle"},
+)
+
+
+class TestCollectCancellation:
+    def test_cancelled_collect_keeps_outstanding_entry(self):
+        async def main():
+            ca, cb = channel_pair()
+            s = PipelinedSession(SPEC, CLIENT, ca, max_outstanding=4)
+            await s.send_pipelined(MsgReq(), "Idle")
+            assert s.outstanding == 1
+            # quiescent peer: a collect() cancelled by a timeout must leave
+            # the pipeline bookkeeping intact (ADVICE r2 medium #1)
+            done, _ = await sim.timeout(0.1, s.collect())
+            assert not done
+            assert s.outstanding == 1
+            # the reply the server still owes matches the right state
+            await cb.send(MsgResp())
+            msg = await s.collect()
+            assert isinstance(msg, MsgResp)
+            assert s.outstanding == 0
+            assert s.state == "Idle"
+        sim.run(main())
+
+    def test_wait_ready_nondestructive_poll(self):
+        async def main():
+            ca, cb = channel_pair()
+            s = PipelinedSession(SPEC, CLIENT, ca, max_outstanding=4)
+            await s.send_pipelined(MsgReq(), "Idle")
+            # nothing pending: poll times out without consuming anything
+            assert await s.channel.wait_ready(0.05) is False
+            assert s.outstanding == 1
+            await cb.send(MsgResp())
+            assert await s.channel.wait_ready(5.0) is True
+            # the message is still there — wait_ready consumed nothing
+            msg = await s.collect()
+            assert isinstance(msg, MsgResp)
+        sim.run(main())
+
+    def test_reply_racing_timeout_is_not_lost(self):
+        """A reply arriving in the SAME instant the timeout fires must not
+        be consumed-and-dropped by the cancelled recv: cancellation beats a
+        pending STM re-run, so the transaction never commits (GHC's
+        async-exception-in-atomically semantics)."""
+        for seed in range(12):
+            async def main():
+                ca, cb = channel_pair()
+                s = PipelinedSession(SPEC, CLIENT, ca, max_outstanding=4)
+                await s.send_pipelined(MsgReq(), "Idle")
+
+                async def server():
+                    await sim.sleep(0.05)
+                    await cb.send(MsgResp())
+                sim.spawn(server(), label="server")
+                done, msg = await sim.timeout(0.05, s.collect())
+                if done:
+                    assert isinstance(msg, MsgResp)
+                else:
+                    # not collected — then it must still be collectable
+                    assert s.outstanding == 1
+                    msg = await s.collect()
+                    assert isinstance(msg, MsgResp)
+                assert s.outstanding == 0
+            sim.run(main(), seed=seed, explore_schedules=True)
+
+    def test_repeated_cancelled_collects_do_not_drift(self):
+        """The failure mode from the advisory: every cancelled poll used to
+        leak one outstanding entry, drifting session.outstanding below the
+        real in-flight count."""
+        async def main():
+            ca, cb = channel_pair()
+            s = PipelinedSession(SPEC, CLIENT, ca, max_outstanding=8)
+            await s.send_pipelined(MsgReq(), "Idle")
+            for _ in range(5):
+                done, _ = await sim.timeout(0.05, s.collect())
+                assert not done
+                assert s.outstanding == 1
+            await cb.send(MsgResp())
+            assert isinstance(await s.collect(), MsgResp)
+            assert s.outstanding == 0
+        sim.run(main())
+
+
+# ---------------------------------------------------------------------------
+# OutsideForecastRange on the block path
+# ---------------------------------------------------------------------------
+
+class HorizonLedger(MockLedger):
+    """Mock ledger with a hard forecast horizon."""
+
+    def __init__(self, genesis, horizon: int):
+        super().__init__(genesis)
+        self.horizon = horizon
+
+    def forecast_view(self, state, slot):
+        if slot > self.horizon:
+            raise OutsideForecastRange(
+                f"slot {slot} beyond horizon {self.horizon}")
+        return self.ledger_view(state)
+
+
+def _bft_env(horizon: int):
+    sks = [hashlib.sha256(b"afr-%d" % i).digest() for i in range(2)]
+    vks = [ed25519_ref.public_key(sk) for sk in sks]
+    protocol = Bft(vks, k=4)
+    ledger = HorizonLedger({}, horizon)
+    ext = ExtLedgerRules(protocol, ledger)
+
+    def block(prev, slot):
+        leader = protocol.slot_leader(slot)
+        h = make_header(prev.header if prev else None, slot, (),
+                        issuer=leader)
+        return ProtocolBlock(bft_sign_header(sks[leader], h), ())
+    return protocol, ledger, ext, block
+
+
+class TestBlockPathForecastHorizon:
+    def test_batched_blocks_return_outside_forecast_range(self):
+        _p, _l, ext, block = _bft_env(horizon=1)
+        b0 = block(None, 0)
+        b1 = block(b0, 1)
+        b2 = block(b1, 2)          # beyond the horizon
+        res = validate_blocks_batched(ext, [b0, b1, b2],
+                                      ext.initial_state(), backend=BACKEND)
+        assert res.n_valid == 2
+        # surfaced as OutsideForecastRange itself, NOT wrapped in
+        # LedgerError (ADVICE r2 medium #2)
+        assert isinstance(res.error, OutsideForecastRange)
+
+    def test_replay_resumable_after_horizon(self):
+        """replay_blocks_pipelined surfaces OutsideForecastRange with the
+        state after the valid prefix, so the caller can resume later."""
+        from ouroboros_tpu.consensus.batch import replay_blocks_pipelined
+        _p, ledger, ext, block = _bft_env(horizon=1)
+        b0 = block(None, 0)
+        b1 = block(b0, 1)
+        b2 = block(b1, 2)
+        res = replay_blocks_pipelined(ext, [b0, b1, b2],
+                                      ext.initial_state(), backend=BACKEND,
+                                      window=2)
+        assert isinstance(res.error, OutsideForecastRange)
+        assert res.n_valid == 2
+        assert res.final_state is not None
+        # chain advances (horizon moves): the replay resumes and completes
+        ledger.horizon = 10
+        res2 = replay_blocks_pipelined(ext, [b2], res.final_state,
+                                       backend=BACKEND, window=2)
+        assert res2.all_valid and res2.n_valid == 1
+
+    def test_chaindb_defers_instead_of_marking_invalid(self):
+        from ouroboros_tpu.storage.chaindb import ChainDB
+        from ouroboros_tpu.storage.ledgerdb import DiskPolicy
+        _p, ledger, ext, block = _bft_env(horizon=1)
+        fs = MockFS()
+        db = ChainDB.open(fs, ext, lambda e: None, lambda o: None,
+                          lambda raw: None, chunk_size=10,
+                          max_blocks_per_file=5, backend=BACKEND,
+                          disk_policy=DiskPolicy(num_snapshots=2,
+                                                 snapshot_interval_slots=1))
+        b0 = block(None, 0)
+        b1 = block(b0, 1)
+        b2 = block(b1, 2)          # beyond the horizon
+        assert db.add_block(b0).kind == "extended"
+        assert db.add_block(b1).kind == "extended"
+        db.add_block(b2)
+        # NOT permanently invalid — just not adopted yet
+        assert b2.hash not in db.invalid
+        assert db.tip_point() == point_of(b1)
+
+
+# ---------------------------------------------------------------------------
+# ImmutableDB length with EBBs
+# ---------------------------------------------------------------------------
+
+class TestImmutableDbEbbLen:
+    def test_len_counts_ebb_and_successor(self):
+        fs = MockFS()
+        db = ImmutableDB.open(fs, chunk_size=10)
+        ebb_hash = hashlib.sha256(b"ebb").digest()
+        blk_hash = hashlib.sha256(b"blk").digest()
+        db.append_block(0, 0, ebb_hash, b"\x00" * 32, b"EBBDATA",
+                        is_ebb=True)
+        db.append_block(0, 1, blk_hash, ebb_hash, b"BLKDATA")
+        assert len(db) == 2                      # was 1 (ADVICE r2 low)
+        # slot lookup resolves to the non-EBB block of a shared slot
+        assert db.get_by_slot(0) == b"BLKDATA"
+        # the EBB stays reachable by hash
+        assert db.get_by_hash(ebb_hash) == b"EBBDATA"
+        # and reopening preserves the count
+        db2 = ImmutableDB.open(fs, chunk_size=10)
+        assert len(db2) == 2
