@@ -36,9 +36,14 @@ sys.path.insert(0, REPO)
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.join(tempfile.gettempdir(), "jax-ouro-cache"))
 
-BLOCKS = 1000
+# 10k blocks (VERDICT r2: measure at the scale the claims are about) in
+# windows of 1024 — per window ONE packed device dispatch carrying the
+# 2048-proof VRF batch, the 4096-sig Ed25519 batch (OCert + KES leaves +
+# witnesses) and the next-next window's 2048 betas, overlapped with the
+# host sequential pass (consensus/batch.py software pipeline)
+BLOCKS = 10000
 TXS = 2
-WINDOW = 500
+WINDOW = 1024
 EPOCH_LEN = 600
 
 
@@ -121,6 +126,8 @@ def bench_primitives(jb):
     from ouroboros_tpu.crypto import ed25519_ref, kes, vrf_ref
     from ouroboros_tpu.crypto.backend import Ed25519Req, KesReq, VrfReq
     out = {}
+    # batch sizes match the replay's bucket shapes so the jit cache is
+    # shared with the flagship run (fresh pallas shapes cost minutes)
     # Ed25519 (config #4 primitive)
     n = 4096
     sk = hashlib.sha256(b"bench-ed").digest()
@@ -139,7 +146,7 @@ def bench_primitives(jb):
     assert all(ok)
     out["ed25519_batch_per_sec"] = round(n / dt, 1)
     # VRF (config #2 primitive)
-    nv = 512
+    nv = 2048
     vsk = hashlib.sha256(b"bench-vrf").digest()
     vvk = vrf_ref.public_key(vsk)
     vreqs = [VrfReq(vvk, b"a%d" % i, vrf_ref.prove(vsk, b"a%d" % i))
@@ -151,7 +158,7 @@ def bench_primitives(jb):
     assert all(okv)
     out["vrf_batch_per_sec"] = round(nv / dt, 1)
     # KES (config #3 primitive): hash path on host + leaf sigs on device
-    nk = 512
+    nk = 4096
     ksk = kes.KesSignKey(6, hashlib.sha256(b"bench-kes").digest())
     kreqs = [KesReq(6, ksk.verification_key, 0, b"m%d" % i,
                     ksk.sign(b"m%d" % i).to_bytes()) for i in range(nk)]

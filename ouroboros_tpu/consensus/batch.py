@@ -273,15 +273,24 @@ def replay_blocks_pipelined(
         protocol.prefetch_window(
             [h for hs, _w in ahead[:2] for h in hs], backend)
 
+    from collections import deque
+
     st = ext_state
-    pending = None                     # (start_index, submit state)
+    # TWO windows in flight: window w's device work has the host passes of
+    # w+1 AND w+2 (plus their dispatch prep) to complete under before its
+    # drain blocks — one-deep left the drain waiting on most of the device
+    # time.  Depth 2 is exactly the beta carry distance: w's submit ships
+    # w+2's betas, and the drain of w at the top of iteration w+2 installs
+    # them right before w+2's sequential pass needs them.
+    pending: deque = deque()
+    depth = 2
     done = 0
 
-    def drain(pending):
+    def drain(entry):
         """Finish a window's device call.  Returns (error, n_valid):
         error None when every proof held, else the global index of the
         first bad block is start + first_bad."""
-        start, sub, reqs, owner, n_seq_w = pending
+        start, sub, reqs, owner, n_seq_w = entry
         ok, betas = backend.finish_window(sub)
         if betas:
             GLOBAL_BETA_CACHE.store_many(betas.keys(), betas.values())
@@ -295,7 +304,25 @@ def replay_blocks_pipelined(
                 f"{start + first_bad}"), start + first_bad
         return None, start + n_seq_w
 
+    def drain_all():
+        """Drain every in-flight window oldest-first; first error wins."""
+        while pending:
+            err, n_ok = drain(pending.popleft())
+            if err is not None:
+                for later in pending:
+                    backend.finish_window(later[1])
+                return err, n_ok
+        return None, done
+
     while ahead:
+        if len(pending) >= depth:
+            # completes window w-2, installing the betas this iteration's
+            # sequential pass is about to read
+            err, n_ok = drain(pending.popleft())
+            if err is not None:
+                for later in pending:
+                    backend.finish_window(later[1])
+                return ReplayResult(None, n_ok, err)
         headers_w, blk_window = ahead.pop(0)
         nxt = next_window()
         if nxt is not None:
@@ -326,28 +353,21 @@ def replay_blocks_pipelined(
                        if len(ahead) > 1 and seq_error is None else ())
         next_proofs = [p for p in next_proofs
                        if p not in GLOBAL_BETA_CACHE]
-        sub = submit(reqs, next_proofs)
-        if pending is not None:
-            err, n_ok = drain(pending)
-            if err is not None:
-                # the earlier window already failed; its index wins
-                backend.finish_window(sub)
-                return ReplayResult(None, n_ok, err)
         done_before = done
         done += n_seq_w
-        pending = (done_before, sub, reqs, owner, n_seq_w)
+        pending.append((done_before, submit(reqs, next_proofs), reqs,
+                        owner, n_seq_w))
         if seq_error is not None:
-            err, n_ok = drain(pending)
+            err, n_ok = drain_all()
             if err is not None:
                 return ReplayResult(None, n_ok, err)
-            # the valid prefix (incl. this window's drained proofs) is
-            # fully verified: resumable when the error is retry-later
+            # the valid prefix (incl. the drained proofs) is fully
+            # verified: resumable when the error is retry-later
             resume = (st if isinstance(seq_error, OutsideForecastRange)
                       else None)
             return ReplayResult(resume, done, seq_error)
 
-    if pending is not None:
-        err, n_ok = drain(pending)
-        if err is not None:
-            return ReplayResult(None, n_ok, err)
+    err, n_ok = drain_all()
+    if err is not None:
+        return ReplayResult(None, n_ok, err)
     return ReplayResult(st, done, None)

@@ -234,10 +234,18 @@ _default: Optional[CryptoBackend] = None
 
 
 def default_backend() -> CryptoBackend:
-    """Best available backend: JAX device if importable, else OpenSSL CPU."""
+    """Best available backend: JAX on a REAL accelerator, else OpenSSL CPU.
+
+    On the cpu platform (tests / machines without a chip) the JAX kernels
+    still work but run the 256-iteration ladders through XLA:CPU at
+    seconds per batch — the C-speed OpenSSL path is the right default
+    there, exactly the libsodium-fallback role from BASELINE.json."""
     global _default
     if _default is None:
         try:
+            import jax
+            if jax.devices()[0].platform == "cpu":
+                raise RuntimeError("cpu platform: use the openssl backend")
             from .jax_backend import JaxBackend
             _default = JaxBackend()
         except Exception:   # no jax / no device: CPU fallback

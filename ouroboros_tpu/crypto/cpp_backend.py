@@ -57,7 +57,48 @@ def load_library():
         ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
     lib.ouro_vrf_verify_batch.restype = None
     lib.ouro_vrf_proof_to_hash.restype = ctypes.c_int
+    lib.ouro_scalarmult.restype = ctypes.c_int
+    lib.ouro_scalarmult.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                    ctypes.c_char_p]
+    lib.ouro_scalarmult_base.restype = None
+    lib.ouro_scalarmult_base.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
     return lib
+
+
+_CACHED_LIB = None
+
+
+def shared_library():
+    """Build-once, load-once module-level handle (None if the toolchain is
+    unavailable) — the host-side fast path for scalar multiplications."""
+    global _CACHED_LIB
+    if _CACHED_LIB is None:
+        try:
+            _CACHED_LIB = load_library()
+        except Exception:
+            _CACHED_LIB = False
+    return _CACHED_LIB or None
+
+
+def scalarmult(pt32: bytes, scalar: int):
+    """[scalar]P for compressed P — compressed result, or None when P does
+    not decode.  C speed; full 256-bit double-and-add ladder, so clamped
+    Ed25519 scalars and mod-L scalars are both fine."""
+    lib = shared_library()
+    if lib is None:
+        return NotImplemented
+    out = ctypes.create_string_buffer(32)
+    ok = lib.ouro_scalarmult(pt32, int.to_bytes(scalar, 32, "little"), out)
+    return out.raw if ok else None
+
+
+def scalarmult_base(scalar: int):
+    lib = shared_library()
+    if lib is None:
+        return NotImplemented
+    out = ctypes.create_string_buffer(32)
+    lib.ouro_scalarmult_base(int.to_bytes(scalar, 32, "little"), out)
+    return out.raw
 
 
 class CppBackend(CryptoBackend):

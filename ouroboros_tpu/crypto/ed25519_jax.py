@@ -70,7 +70,7 @@ def _identity_like(ref):
     same sharding/varying-axis type under shard_map (a constant-built carry
     would fail lax.fori_loop's carry-type check inside shard_map)."""
     zero = ref * 0
-    one = zero.at[0].add(1)   # limb vector of the field element 1
+    one = F.one_like(ref)
     return (zero, one, one, zero)
 
 
@@ -172,7 +172,7 @@ def decompress_kernel(y):
     Host applies the cheap final steps (root-check, sqrt(-1) twist, sign).
     """
     n = y.shape[1]
-    one = (y * 0).at[0].add(1)
+    one = F.one_like(y)
     y2 = F.mul(y, y)
     u = F.sub(y2, one)
     v = F.add(F.mul(F.const_batch(ed.D, n), y2), one)
@@ -189,7 +189,7 @@ def device_decompress(y, sign):
     no square root exists or x == 0 with sign == 1.  Bit-exact vs
     edwards.decompress (host parse already rejected y >= p)."""
     n = y.shape[1]
-    one = (y * 0).at[0].add(1)
+    one = F.one_like(y)
     y2 = F.mul(y, y)
     u = F.sub(y2, one)
     v = F.add(F.mul(F.const_batch(ed.D, n), y2), one)
@@ -208,7 +208,7 @@ def device_decompress(y, sign):
     ok = jnp.logical_and(ok, ~jnp.logical_and(x_is_zero, sign == 1))
     # p - x for canonical x needs only one borrow pass (value in [1, p]);
     # for x == 0 it yields the limbs of p ≡ 0, harmless as ladder input
-    x_neg, _ = F._exact_scan(jnp.asarray(F._P_LIMBS) - x)
+    x_neg, _ = F._exact_scan(F.p_col(x.shape[1]) - x)
     x = jnp.where((parity != sign)[None, :], x_neg, x)
     return x, ok
 

@@ -26,12 +26,12 @@ def secret_expand(sk: bytes) -> tuple[int, bytes]:
     return _clamp(h[:32]), h[32:]
 
 
-def public_key(sk: bytes) -> bytes:
+def public_key_pure(sk: bytes) -> bytes:
     a, _ = secret_expand(sk)
     return ed.compress(ed.scalar_mult(a, BASE))
 
 
-def sign(sk: bytes, msg: bytes) -> bytes:
+def sign_pure(sk: bytes, msg: bytes) -> bytes:
     a, prefix = secret_expand(sk)
     vk = ed.compress(ed.scalar_mult(a, BASE))
     r = ed.sha512_int(prefix, msg) % L
@@ -39,6 +39,25 @@ def sign(sk: bytes, msg: bytes) -> bytes:
     k = ed.sha512_int(R, vk, msg) % L
     s = (r + k * a) % L
     return R + int.to_bytes(s, 32, "little")
+
+
+# Ed25519 signing is deterministic (RFC 8032), so the OpenSSL path emits
+# byte-identical keys/signatures at C speed — the pure functions above
+# remain the spec and the cross-check oracle (tests/test_crypto_ref.py).
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey as _SslKey,
+    )
+
+    def public_key(sk: bytes) -> bytes:
+        return _SslKey.from_private_bytes(sk).public_key()\
+            .public_bytes_raw()
+
+    def sign(sk: bytes, msg: bytes) -> bytes:
+        return _SslKey.from_private_bytes(sk).sign(msg)
+except Exception:                                  # pragma: no cover
+    public_key = public_key_pure
+    sign = sign_pure
 
 
 def verify(vk: bytes, msg: bytes, sig: bytes) -> bool:

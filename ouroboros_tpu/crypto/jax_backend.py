@@ -50,51 +50,143 @@ def batch_inverse(vals: list[int]) -> list[int]:
 class JaxBackend(CryptoBackend):
     name = "jax-tpu"
 
-    def __init__(self, min_bucket: int = 128):
+    def __init__(self, min_bucket: int = 128, use_pallas: bool | None = None):
         import jax  # fail here if jax unusable -> default_backend falls back
+        from .pallas_kernels import _ensure_compile_cache
+        _ensure_compile_cache()   # ladder compiles are minutes; cache them
         self._devices = jax.devices()
+        if use_pallas is None:
+            # fused Mosaic kernels on a real chip (~5-50x the op-by-op XLA
+            # path); XLA kernels elsewhere (pallas interpret mode would
+            # just re-run the same jnp ops with extra overhead)
+            use_pallas = self._devices[0].platform == "tpu"
+        self.use_pallas = use_pallas
+        if use_pallas:
+            from . import pallas_kernels as PK
+            self._pk = PK
+            min_bucket = max(min_bucket, PK.TILE)
         self.min_bucket = min_bucket
+        self._composites: dict = {}   # (ne, nv, nb) -> fused window program
+
+    # -- pallas runners (vrf_jax._submit/_submit_betas plug-ins) -----------
+    def _ed_submit(self, arrays):
+        """Async-dispatch one prepared Ed25519 batch; (n,) int32 handle."""
+        if not self.use_pallas:
+            return EJ.verify_kernel_full_submit(arrays)
+        import jax.numpy as jnp
+        yA, signA, yR, signR, s_bits, k_bits = arrays
+        return self._pk.ed25519_verify_pallas(
+            jnp.asarray(yA), jnp.asarray(signA), jnp.asarray(yR),
+            jnp.asarray(signR), jnp.asarray(s_bits), jnp.asarray(k_bits),
+            yA.shape[1]).reshape(-1)
+
+    @property
+    def _vrf_runner(self):
+        return self._pk.vrf_verify_pallas if self.use_pallas else None
+
+    @property
+    def _beta_runner(self):
+        return self._pk.gamma8_pallas if self.use_pallas else None
 
     def verify_ed25519_batch(self, reqs):
         if not reqs:
             return []
-        vks = [r.vk for r in reqs]
-        msgs = [r.msg for r in reqs]
-        sigs = [r.sig for r in reqs]
-        return EJ.batch_verify(vks, msgs, sigs,
-                               pad_to=_bucket(len(reqs), self.min_bucket))
+        import numpy as np
+        n = len(reqs)
+        m = _bucket(n, self.min_bucket)
+        pad = m - n
+        arrays, parse_ok = EJ.prepare_bytes_batch(
+            [r.vk for r in reqs] + [b"\x00" * 32] * pad,
+            [r.msg for r in reqs] + [b""] * pad,
+            [r.sig for r in reqs] + [b"\x00" * 64] * pad)
+        ok = np.asarray(self._ed_submit(arrays))
+        return [bool(o) and bool(p)
+                for o, p in zip(ok[:n], parse_ok[:n])]
 
     def verify_vrf_batch(self, reqs):
         if not reqs:
             return []
         from . import vrf_jax
-        oks, _betas = vrf_jax.batch_verify_vrf(
-            [r.vk for r in reqs], [r.alpha for r in reqs],
-            [r.proof for r in reqs],
-            pad_to=_bucket(len(reqs), self.min_bucket))
+        n = len(reqs)
+        m = _bucket(n, self.min_bucket)
+        state = vrf_jax._submit(
+            [r.vk for r in reqs] + [b"\x00" * 32] * (m - n),
+            [r.alpha for r in reqs] + [b""] * (m - n),
+            [r.proof for r in reqs] + [b"\x00" * 80] * (m - n), m,
+            runner=self._vrf_runner)
+        oks, _betas = vrf_jax._finish(*state, n)
         return oks
 
+    # largest single gamma8 dispatch: bounds the set of compiled shapes
+    # (a fresh pallas shape costs minutes through the AOT helper)
+    BETA_CHUNK = 2048
+
     def vrf_betas_batch(self, proofs):
+        import numpy as np
         from . import vrf_jax
-        return vrf_jax.batch_betas(
-            proofs, pad_to=_bucket(len(proofs), self.min_bucket))
+        n = len(proofs)
+        if n == 0:
+            return []
+        if n > self.BETA_CHUNK:
+            out = []
+            for off in range(0, n, self.BETA_CHUNK):
+                out.extend(self.vrf_betas_batch(
+                    proofs[off:off + self.BETA_CHUNK]))
+            return out
+        m = _bucket(n, self.min_bucket)
+        padded = list(proofs) + [b"\x00" * 80] * (m - n)
+        handle, decode_ok = vrf_jax._submit_betas(
+            padded, m, runner=self._beta_runner)
+        return vrf_jax._finish_betas(np.asarray(handle), decode_ok, n)
+
+    def _window_composite(self, ne: int, nv: int, nb: int):
+        """One jitted device program for a whole window: Ed25519 verify +
+        VRF verify + next-window gamma8 betas, results concatenated into
+        the packed flat uint8 buffer on device.  ONE launch per window —
+        separate dispatches each pay the accelerator tunnel's fixed launch
+        latency (~150-200 ms), which dominated the replay."""
+        key = (ne, nv, nb)
+        fn = self._composites.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+        PK = self._pk
+
+        def call(ed_args, vrf_args, beta_args):
+            parts = []
+            if ed_args is not None:
+                ok = PK._ed25519_verify_call(*ed_args, ne)
+                parts.append(ok.reshape(-1).astype(jnp.uint8))
+            if vrf_args is not None:
+                parts.append(PK._vrf_verify_call(*vrf_args, nv).reshape(-1))
+            if beta_args is not None:
+                parts.append(PK._gamma8_call(*beta_args, nb).reshape(-1))
+            return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+        fn = call if PK._interpret() else jax.jit(call)
+        self._composites[key] = fn
+        return fn
 
     def submit_window(self, reqs, next_beta_proofs=()):
         """Dispatch one replay window's whole device workload — the mixed
         Ed25519/VRF/KES verification of `reqs` AND the VRF betas the NEXT
-        window's sequential pass will need — as async kernel calls whose
-        results are packed on-device into ONE flat uint8 array, so the
-        latency-bound host<->device link is crossed exactly once per
-        window.  Returns an opaque state for finish_window."""
+        window's sequential pass will need — as ONE fused device program
+        whose results are packed into ONE flat uint8 array: the
+        latency-bound host<->device link is crossed once per window, and
+        the launch overhead is paid once instead of per kernel.  Returns
+        an opaque state for finish_window."""
         import numpy as np
 
         import jax.numpy as jnp
 
         from . import vrf_jax
         ed_reqs, ed_owner, vrf_reqs, vrf_owner, n = self.split_mixed(reqs)
-        parts = []
+        beta_proofs = list(dict.fromkeys(next_beta_proofs))
         ed_state = vrf_state = beta_state = None
         ne = nv = nb = 0
+        ed_args = vrf_args = beta_args = None
+        parts = []          # XLA-path fallback accumulation
         if ed_reqs:
             ne = _bucket(len(ed_reqs), self.min_bucket)
             pad = ne - len(ed_reqs)
@@ -102,24 +194,52 @@ class JaxBackend(CryptoBackend):
                 [r.vk for r in ed_reqs] + [b"\x00" * 32] * pad,
                 [r.msg for r in ed_reqs] + [b""] * pad,
                 [r.sig for r in ed_reqs] + [b"\x00" * 64] * pad)
-            ed_state = (EJ.verify_kernel_full_submit(arrays), parse_ok)
-            parts.append(ed_state[0].astype(jnp.uint8))
+            ed_state = (None, parse_ok)
+            if self.use_pallas:
+                yA, signA, yR, signR, s_bits, k_bits = arrays
+                ed_args = (jnp.asarray(yA),
+                           jnp.asarray(signA.reshape(1, -1)),
+                           jnp.asarray(yR),
+                           jnp.asarray(signR.reshape(1, -1)),
+                           jnp.asarray(s_bits), jnp.asarray(k_bits))
+            else:
+                parts.append(EJ.verify_kernel_full_submit(arrays)
+                             .astype(jnp.uint8))
         if vrf_reqs:
             nv = _bucket(len(vrf_reqs), self.min_bucket)
             pad = nv - len(vrf_reqs)
-            vrf_state = vrf_jax._submit(
+            args, parse_ok, gamma_ok, s_ok, pf_arr = vrf_jax._prepare(
                 [r.vk for r in vrf_reqs] + [b"\x00" * 32] * pad,
                 [r.alpha for r in vrf_reqs] + [b""] * pad,
-                [r.proof for r in vrf_reqs] + [b"\x00" * 80] * pad, nv)
-            parts.append(vrf_state[0].reshape(-1))
-        beta_proofs = list(dict.fromkeys(next_beta_proofs))
+                [r.proof for r in vrf_reqs] + [b"\x00" * 80] * pad)
+            vrf_state = (None, parse_ok, gamma_ok, s_ok, pf_arr)
+            if self.use_pallas:
+                yY, signY, yG, signG, r_l, c_b, lo_b, hi_b = args
+                vrf_args = (jnp.asarray(yY),
+                            jnp.asarray(signY.reshape(1, -1)),
+                            jnp.asarray(yG),
+                            jnp.asarray(signG.reshape(1, -1)),
+                            jnp.asarray(r_l), jnp.asarray(c_b),
+                            jnp.asarray(lo_b), jnp.asarray(hi_b))
+            else:
+                parts.append(vrf_jax._default_runner(*args).reshape(-1))
         if beta_proofs:
             nb = _bucket(len(beta_proofs), self.min_bucket)
             padded = beta_proofs + [b"\x00" * 80] * (nb - len(beta_proofs))
-            handle, decode_ok = vrf_jax._submit_betas(padded, nb)
+            (yG, signG), decode_ok = vrf_jax._prepare_betas(padded)
             beta_state = (decode_ok,)
-            parts.append(handle.reshape(-1))
-        packed = _pack_flat(parts) if parts else None
+            if self.use_pallas:
+                beta_args = (jnp.asarray(yG),
+                             jnp.asarray(signG.reshape(1, -1)))
+            else:
+                parts.append(vrf_jax.gamma8_kernel(
+                    jnp.asarray(yG), jnp.asarray(signG)).reshape(-1))
+        if self.use_pallas and (ed_args is not None or vrf_args is not None
+                                or beta_args is not None):
+            packed = self._window_composite(ne, nv, nb)(
+                ed_args, vrf_args, beta_args)
+        else:
+            packed = _pack_flat(parts) if parts else None
         return {"packed": packed, "n": n,
                 "ed": ed_state, "ed_owner": ed_owner, "ne": ne,
                 "vrf": vrf_state, "vrf_owner": vrf_owner,

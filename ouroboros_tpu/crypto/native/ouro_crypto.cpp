@@ -495,6 +495,25 @@ static int sc_less_than_L(const u8 s[32]) {
     return 0;   // equal
 }
 
+// ------------------------------------------------- generic scalar mults
+// (host-side forging/proving helpers: db_synth-scale chains need C-speed
+// [k]P; verification stays in the batch entry points below)
+extern "C" int ouro_scalarmult(const u8 pt[32], const u8 sc[32],
+                               u8 out[32]) {
+    ge P_, R;
+    if (!ge_decompress(&P_, pt)) return 0;
+    ge_scalar_mult(&R, sc, &P_);
+    ge_compress(out, &R);
+    return 1;
+}
+
+extern "C" void ouro_scalarmult_base(const u8 sc[32], u8 out[32]) {
+    ge B, R;
+    ge_base(&B);
+    ge_scalar_mult(&R, sc, &B);
+    ge_compress(out, &R);
+}
+
 // ------------------------------------------------------------- Ed25519
 extern "C" int ouro_ed25519_verify(const u8 vk[32], const u8* msg,
                                    size_t len, const u8 sig[64]) {

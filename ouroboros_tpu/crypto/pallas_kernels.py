@@ -1,0 +1,369 @@
+"""Fused Pallas TPU kernels for the batched Ed25519 / ECVRF hot loops.
+
+Why pallas: the XLA op-by-op kernels (ed25519_jax.verify_full_kernel,
+vrf_jax.vrf_verify_kernel) plateau at ~13k Ed25519/s and ~7k VRF/s on one
+v5e chip — every field multiplication is ~45 separate HLO ops whose
+intermediates round-trip HBM, so the ladder is bound by per-op overhead
+and HBM bandwidth, not VPU arithmetic.  Fusing the whole Strauss-Shamir
+ladder into one pallas kernel keeps Q, the select table, and every carry
+chain in VMEM for all 256 iterations; only the inputs (limbs + scalar
+bits) and the final acceptance mask cross HBM.
+
+The field arithmetic is field_jax's: radix-2^13 × 20 int32 limbs, lazy
+carries, fold via 2^260 ≡ 608 — pure jnp ops on static shapes, which is
+exactly what Mosaic lowers; the functions are imported and used unchanged
+inside the kernel body (bit-exactness oracle: ed25519_ref/vrf_ref, same as
+the XLA path).
+
+Grid: 1-D over lane tiles of TILE items; each program verifies TILE
+signatures/proofs independently (batch on the 128-lane axis, limbs on
+sublanes).
+
+Reference seam (what this accelerates): the per-header VRF+KES+Ed25519
+verification of Shelley/Protocol.hs:433-442 and the BBODY witness
+multi-verify of Shelley/Ledger/Ledger.hs:279-284, batched per SURVEY.md §7.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import ed25519_jax as EJ
+from . import edwards as ed
+from . import field_jax as F
+
+TILE = 512          # batch items per grid program (lane axis)
+
+
+def _ensure_compile_cache() -> None:
+    """Point JAX's persistent compilation cache somewhere durable.  The
+    env var route (JAX_COMPILATION_CACHE_DIR) silently fails on machines
+    where an accelerator plugin imports jax at interpreter start, before
+    user code can set it — config.update always wins.  The ladder kernels
+    take minutes to compile; the cache makes that once per machine."""
+    import os
+    import tempfile
+    d = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        tempfile.gettempdir(), "jax-ouro-cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        pass
+
+
+_ensure_compile_cache()
+
+
+def _interpret() -> bool:
+    """Run the kernels in interpreter mode off-TPU (CPU tests / the
+    8-device virtual mesh) — Mosaic lowering is TPU-only."""
+    return jax.devices()[0].platform == "cpu"
+
+
+def _pt_add(p, q, n):
+    return EJ.pt_add(p, q, n)
+
+
+def _pt_double(p):
+    return EJ.pt_double(p)
+
+
+def _select_bit(table, idx):
+    """4-entry point-table select by 2-bit index (N,) — where-chain, no
+    one-hot multiply (cheaper on the VPU than the 4-way one-hot sum)."""
+    out = []
+    for c in range(4):
+        t = table[0][c]
+        t = jnp.where((idx == 1)[None, :], table[1][c], t)
+        t = jnp.where((idx == 2)[None, :], table[2][c], t)
+        t = jnp.where((idx == 3)[None, :], table[3][c], t)
+        out.append(t)
+    return tuple(out)
+
+
+def _ed25519_verify_kernel(yA_ref, signA_ref, yR_ref, signR_ref,
+                           s_bits_ref, k_bits_ref, ok_ref):
+    """One TILE of full Ed25519 verification: decompress A and R, run the
+    256-iteration dual-scalar ladder Q = [s]B + [k](-A), compare vs R."""
+    n = TILE
+    yA = yA_ref[:]
+    yR = yR_ref[:]
+    signA = signA_ref[0, :]
+    signR = signR_ref[0, :]
+    xA, okA = EJ.device_decompress(yA, signA)
+    xR, okR = EJ.device_decompress(yR, signR)
+    one = F.const_batch(1, n)
+    nax = F.sub(yA * 0, xA)
+    negA = (nax, yA, one, F.mul(nax, yA))
+    gx, gy = ed.to_affine(ed.BASE)
+    Bpt = (F.const_batch(gx, n), F.const_batch(gy, n), one,
+           F.const_batch(gx * gy % ed.P, n))
+    T3 = _pt_add(Bpt, negA, n)
+    ident = EJ._identity_like(yA)
+    table = (ident, Bpt, negA, T3)
+
+    def body(i, Q):
+        Q = _pt_double(Q)
+        sb = s_bits_ref[i, :]
+        kb = k_bits_ref[i, :]
+        entry = _select_bit(table, sb + 2 * kb)
+        return _pt_add(Q, entry, n)
+
+    Q = lax.fori_loop(0, 256, body, ident)
+    X, Y, Z, _ = Q
+    d1 = F.sub(F.mul(xR, Z), X)
+    d2 = F.sub(F.mul(yR, Z), Y)
+    ok = jnp.logical_and(jnp.logical_and(okA, okR),
+                         jnp.logical_and(F.is_zero(d1), F.is_zero(d2)))
+    ok_ref[0, :] = ok.astype(jnp.int32)
+
+
+def _ed25519_verify_call(yA, signA2d, yR, signR2d, s_bits, k_bits, n: int):
+    grid = n // TILE
+    lane = lambda i: (0, i)     # block index along the lane axis
+    limb_spec = pl.BlockSpec((F.NLIMBS, TILE), lane,
+                             memory_space=pltpu.VMEM)
+    sign_spec = pl.BlockSpec((1, TILE), lane, memory_space=pltpu.VMEM)
+    bits_spec = pl.BlockSpec((256, TILE), lane, memory_space=pltpu.VMEM)
+    with F.mul_impl("columns"):
+        return pl.pallas_call(
+            _ed25519_verify_kernel,
+            grid=(grid,),
+            in_specs=[limb_spec, sign_spec, limb_spec, sign_spec,
+                      bits_spec, bits_spec],
+            out_specs=pl.BlockSpec((1, TILE), lane,
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((1, n), jnp.int32),
+            interpret=_interpret(),
+        )(yA, signA2d, yR, signR2d, s_bits, k_bits)
+
+
+# jit on the real device (an un-jitted pallas_call re-lowers and re-compiles
+# on EVERY invocation through the axon remote-compile path — ~60s/call for
+# this kernel); interpret mode must stay un-jitted (jit-of-interpret crashes
+# XLA:CPU).
+_ed25519_verify_jit = jax.jit(_ed25519_verify_call,
+                              static_argnames=("n",))
+
+
+def ed25519_verify_pallas(yA, signA, yR, signR, s_bits, k_bits, n: int):
+    """Batched Ed25519 verify, pallas path.  Inputs as in
+    ed25519_jax.verify_full_core; n must be a multiple of TILE."""
+    call = _ed25519_verify_call if _interpret() else _ed25519_verify_jit
+    return call(yA, signA.reshape(1, -1), yR, signR.reshape(1, -1),
+                s_bits, k_bits, n)
+
+
+# ---------------------------------------------------------------------------
+# VRF (ECVRF-ED25519-SHA512-Elligator2) — the vrf_jax.vrf_verify_core device
+# half as one fused kernel
+# ---------------------------------------------------------------------------
+
+def _select8(table, idx):
+    """8-entry point-table select by 3-bit index — where-chain per coord."""
+    out = []
+    for c in range(4):
+        t = table[0][c]
+        for e in range(1, 8):
+            t = jnp.where((idx == e)[None, :], table[e][c], t)
+        out.append(t)
+    return tuple(out)
+
+
+def _bytes_rows_from_limbs(yc, sign):
+    """Canonical limbs (NLIMBS, M) + parity row (M,) -> (32, M) int32 byte
+    values of the compressed encoding.  Each byte spans at most two 13-bit
+    limbs: byte k = ((limb[l] >> s) | (limb[l+1] << (13-s))) & 0xFF with
+    l = 8k // 13, s = 8k mod 13 — 2-D ops only (pallas-safe, unlike the
+    XLA path's 3-D unpack in vrf_jax.compress_device)."""
+    rows = []
+    for k in range(32):
+        bit = 8 * k
+        l, s = bit // F.RADIX, bit % F.RADIX
+        v = yc[l:l + 1] >> s
+        if F.RADIX - s < 8 and l + 1 < F.NLIMBS:
+            v = v | (yc[l + 1:l + 2] << (F.RADIX - s))
+        rows.append(v & 0xFF)
+    out = jnp.concatenate(rows, axis=0)
+    return F._row_update(out, 31, out[31] + (sign << 7))
+
+
+def _compress_rows(x_aff, y_aff):
+    yc = F.canon(y_aff)
+    xc = F.canon(x_aff)
+    return _bytes_rows_from_limbs(yc, xc[0] & 1)
+
+
+def _triple_ladder(P1, P1p, P2, lo_ref, hi_ref, c_ref, n):
+    """Q = [lo]P1 + [hi]P1' + [c]P2, 128 iterations, 8-entry where-select
+    (vrf_jax._triple_ladder_128, Mosaic-safe form: scalar-bit rows are read
+    from the refs — a dynamic_slice of a value has no lowering — and no
+    lane-direction concatenation anywhere)."""
+    ident = EJ._identity_like(P1[0])
+    t3 = EJ.pt_add(P1, P1p, n)
+    t5 = EJ.pt_add(P1, P2, n)
+    t6 = EJ.pt_add(P1p, P2, n)
+    t7 = EJ.pt_add(t3, P2, n)
+    table = (ident, P1, P1p, t3, P2, t5, t6, t7)
+
+    def body(i, Q):
+        Q = EJ.pt_double(Q)
+        idx = lo_ref[i, :] + 2 * hi_ref[i, :] + 4 * c_ref[i, :]
+        return EJ.pt_add(Q, _select8(table, idx), n)
+
+    return lax.fori_loop(0, 128, body, ident)
+
+
+def _affine_bytes(pt, n):
+    """Projective point batch -> (32, n) compressed-encoding byte rows."""
+    Zi = EJ.pow_inv(pt[2])
+    return _compress_rows(F.mul(pt[0], Zi), F.mul(pt[1], Zi))
+
+
+def _vrf_verify_kernel(yY_ref, signY_ref, yG_ref, signG_ref, r_ref,
+                       c_ref, lo_ref, hi_ref, out_ref):
+    """One TILE of the VRF device half (see vrf_jax.vrf_verify_core).
+
+    out rows: [0:32] H bytes, [32:64] U, [64:96] V, [96:128] [8]Gamma,
+    [128] okY, [129] okG."""
+    from . import vrf_jax as VJ
+    n = TILE
+    yY = yY_ref[:]
+    yG = yG_ref[:]
+    one = F.one_like(yY)
+    xY, okY = EJ.device_decompress(yY, signY_ref[0, :])
+    xG, okG = EJ.device_decompress(yG, signG_ref[0, :])
+    H = VJ._double3(VJ.elligator2_fraction(r_ref[:]))
+    G8 = VJ._double3((xG, yG, one, F.mul(xG, yG)))
+    nYx = F.sub(yY * 0, xY)
+    nGx = F.sub(yG * 0, xG)
+    B = (F.const_batch(_GX, n), F.const_batch(_GY, n), one,
+         F.const_batch(_GX * _GY % ed.P, n))
+    Bp = (F.const_batch(_G2X, n), F.const_batch(_G2Y, n), one,
+          F.const_batch(_G2X * _G2Y % ed.P, n))
+    Hp = lax.fori_loop(0, 128, lambda _, p: EJ.pt_double(p), H)
+    negY = (nYx, yY, one, F.mul(nYx, yY))
+    negG = (nGx, yG, one, F.mul(nGx, yG))
+    U = _triple_ladder(B, Bp, negY, lo_ref, hi_ref, c_ref, n)
+    V = _triple_ladder(H, Hp, negG, lo_ref, hi_ref, c_ref, n)
+    out_ref[:] = jnp.concatenate(
+        [_affine_bytes(H, n), _affine_bytes(U, n), _affine_bytes(V, n),
+         _affine_bytes(G8, n),
+         okY.astype(jnp.int32)[None, :], okG.astype(jnp.int32)[None, :]],
+        axis=0)
+
+
+# module-constant mirrors of vrf_jax's (kept local so the kernel body has
+# no numpy-array captures)
+from . import vrf_jax as _VJ  # noqa: E402  (after EJ/F to avoid cycles)
+
+_GX, _GY = _VJ._GX, _VJ._GY
+_G2X, _G2Y = _VJ._G2X, _VJ._G2Y
+
+
+def _vrf_verify_call(yY, signY2d, yG, signG2d, r, c_bits, lo_bits, hi_bits,
+                     n: int):
+    grid = n // TILE
+    lane = lambda i: (0, i)
+    limb_spec = pl.BlockSpec((F.NLIMBS, TILE), lane,
+                             memory_space=pltpu.VMEM)
+    sign_spec = pl.BlockSpec((1, TILE), lane, memory_space=pltpu.VMEM)
+    bits_spec = pl.BlockSpec((128, TILE), lane, memory_space=pltpu.VMEM)
+    with F.mul_impl("columns"):
+        rows = pl.pallas_call(
+            _vrf_verify_kernel,
+            grid=(grid,),
+            in_specs=[limb_spec, sign_spec, limb_spec, sign_spec, limb_spec,
+                      bits_spec, bits_spec, bits_spec],
+            out_specs=pl.BlockSpec((130, TILE), lane,
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((130, n), jnp.int32),
+            interpret=_interpret(),
+        )(yY, signY2d, yG, signG2d, r, c_bits, lo_bits, hi_bits)
+    # (N, 130) uint8, the layout vrf_jax._finish expects
+    return rows.T.astype(jnp.uint8)
+
+
+_vrf_verify_jit = jax.jit(_vrf_verify_call, static_argnames=("n",))
+
+
+def vrf_verify_pallas(yY, signY, yG, signG, r, c_bits, lo_bits, hi_bits):
+    """vrf_jax runner signature (drop-in for _submit's `runner` arg)."""
+    n = yY.shape[1]
+    call = _vrf_verify_call if _interpret() else _vrf_verify_jit
+    return call(jnp.asarray(yY), jnp.asarray(signY).reshape(1, -1),
+                jnp.asarray(yG), jnp.asarray(signG).reshape(1, -1),
+                jnp.asarray(r), jnp.asarray(c_bits), jnp.asarray(lo_bits),
+                jnp.asarray(hi_bits), n)
+
+
+# ---------------------------------------------------------------------------
+# [8]Gamma (proof_to_hash) — gamma8_kernel as a pallas kernel
+# ---------------------------------------------------------------------------
+
+def _gamma8_kernel(yG_ref, signG_ref, out_ref):
+    yG = yG_ref[:]
+    one = F.one_like(yG)
+    xG, okG = EJ.device_decompress(yG, signG_ref[0, :])
+    from . import vrf_jax as VJ
+    G8 = VJ._double3((xG, yG, one, F.mul(xG, yG)))
+    Zi = EJ.pow_inv(G8[2])
+    comp = _compress_rows(F.mul(G8[0], Zi), F.mul(G8[1], Zi))
+    out_ref[:] = jnp.concatenate(
+        [comp, okG.astype(jnp.int32)[None, :]], axis=0)
+
+
+def _gamma8_call(yG, signG2d, n: int):
+    grid = n // TILE
+    lane = lambda i: (0, i)
+    with F.mul_impl("columns"):
+        rows = pl.pallas_call(
+            _gamma8_kernel,
+            grid=(grid,),
+            in_specs=[pl.BlockSpec((F.NLIMBS, TILE), lane,
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((1, TILE), lane,
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((33, TILE), lane,
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((33, n), jnp.int32),
+            interpret=_interpret(),
+        )(yG, signG2d)
+    return rows.T.astype(jnp.uint8)      # (N, 33), vrf_jax._finish_betas
+
+
+_gamma8_jit = jax.jit(_gamma8_call, static_argnames=("n",))
+
+
+def gamma8_pallas(yG, signG):
+    """vrf_jax._submit_betas runner signature."""
+    n = yG.shape[1]
+    call = _gamma8_call if _interpret() else _gamma8_jit
+    return call(jnp.asarray(yG), jnp.asarray(signG).reshape(1, -1), n)
+
+
+def batch_verify_ed25519(vks, msgs, sigs) -> list[bool]:
+    """End-to-end pallas-batched verify (host prep identical to the XLA
+    path; padding to a TILE multiple)."""
+    n = len(vks)
+    if n == 0:
+        return []
+    m = ((n + TILE - 1) // TILE) * TILE
+    vks = list(vks) + [b"\x00" * 32] * (m - n)
+    msgs = list(msgs) + [b""] * (m - n)
+    sigs = list(sigs) + [b"\x00" * 64] * (m - n)
+    arrays, parse_ok = EJ.prepare_bytes_batch(vks, msgs, sigs)
+    yA, signA, yR, signR, s_bits, k_bits = arrays
+    ok = np.asarray(ed25519_verify_pallas(
+        jnp.asarray(yA), jnp.asarray(signA), jnp.asarray(yR),
+        jnp.asarray(signR), jnp.asarray(s_bits), jnp.asarray(k_bits),
+        m))[0]
+    return [bool(o) and bool(p) for o, p in zip(ok[:n], parse_ok[:n])]

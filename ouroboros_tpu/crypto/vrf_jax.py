@@ -104,7 +104,7 @@ def _sqrt_ratio(u, v):
                                                          u.shape[1])))
     x = F.canon(x)
     # parity 0 (sign bit 0 of the compressed-with-sign-0 encoding)
-    x_neg, _ = F._exact_scan(jnp.asarray(F._P_LIMBS) - x)
+    x_neg, _ = F._exact_scan(F.p_col(x.shape[1]) - x)
     return _select((x[0] & 1) == 1, x_neg, x), ok
 
 
@@ -118,7 +118,7 @@ def elligator2_fraction(r):
     square W^4), and y = (U-W)/(U+W) stays a fraction all the way into
     the sqrt ratio.  Returns extended (X, Y, Z, T) with Z = U+W."""
     n = r.shape[1]
-    one = (r * 0).at[0].add(1)
+    one = F.one_like(r)
     Ac = F.const_batch(_A, n)
     r2 = F.mul(r, r)
     two_r2 = F.add(r2, r2)
@@ -182,7 +182,7 @@ def vrf_verify_core(yY, signY, yG, signG, r, c_bits, s_lo_bits, s_hi_bits):
       [128]    okY  [129]  okG
     """
     n = yY.shape[1]
-    one = (yY * 0).at[0].add(1)
+    one = F.one_like(yY)
     xY, okY = EJ.device_decompress(yY, signY)
     xG, okG = EJ.device_decompress(yG, signG)
     H = _double3(elligator2_fraction(r))             # cofactor clearing
@@ -228,7 +228,7 @@ def gamma8_kernel(yG, signG):
     """[8]Gamma compressed, for batched beta derivation (proof_to_hash).
     Returns (N, 33) uint8: compressed [8]Gamma + ok flag."""
     n = yG.shape[1]
-    one = (yG * 0).at[0].add(1)
+    one = F.one_like(yG)
     xG, okG = EJ.device_decompress(yG, signG)
     G8 = _double3((xG, yG, one, F.mul(xG, yG)))
     Zi = EJ.pow_inv(G8[2])
@@ -265,11 +265,13 @@ def _default_runner(*args):
     return vrf_verify_kernel(*(jnp.asarray(a) for a in args))
 
 
-def _submit(vks, alphas, proofs, m, runner=None):
-    """Parse + dispatch one padded batch; returns (device handle, masks,
-    proof rows).  Does not block — callers may pipeline.  `runner` swaps
-    the kernel invocation (e.g. parallel.sharded_verify's mesh-sharded
-    variant)."""
+def _prepare(vks, alphas, proofs):
+    """Host-side parse of one padded batch into kernel inputs.
+
+    Returns (kernel_args, parse_ok, gamma_ok, s_ok, pf_arr); kernel_args
+    is the 8-tuple the verify kernels take (limbs + sign vectors + bit
+    rows), so callers can dispatch it themselves (e.g. fused into one
+    per-window device program)."""
     vk_arr, vk_ok = EJ._bytes_rows(vks, 32)
     pf_arr, pf_ok = EJ._bytes_rows(proofs, PROOF_LEN)
     yY, signY, okYc = EJ._decode_compressed(vk_arr)
@@ -278,12 +280,21 @@ def _submit(vks, alphas, proofs, m, runner=None):
     s_ok = EJ._scalar_lt_L(s_rows)
     gamma_ok = pf_ok & okGc
     parse_ok = vk_ok & okYc & gamma_ok & s_ok
-    handle = (runner or _default_runner)(
-        yY, signY.astype(np.int32), yG, signG.astype(np.int32),
-        _r_limbs(vks, alphas),
-        _bits128_from_le(np.ascontiguousarray(pf_arr[:, 32:48])),  # c
-        _bits128_from_le(np.ascontiguousarray(s_rows[:, :16])),    # s lo
-        _bits128_from_le(np.ascontiguousarray(s_rows[:, 16:])))    # s hi
+    args = (yY, signY.astype(np.int32), yG, signG.astype(np.int32),
+            _r_limbs(vks, alphas),
+            _bits128_from_le(np.ascontiguousarray(pf_arr[:, 32:48])),  # c
+            _bits128_from_le(np.ascontiguousarray(s_rows[:, :16])),    # lo
+            _bits128_from_le(np.ascontiguousarray(s_rows[:, 16:])))    # hi
+    return args, parse_ok, gamma_ok, s_ok, pf_arr
+
+
+def _submit(vks, alphas, proofs, m, runner=None):
+    """Parse + dispatch one padded batch; returns (device handle, masks,
+    proof rows).  Does not block — callers may pipeline.  `runner` swaps
+    the kernel invocation (e.g. parallel.sharded_verify's mesh-sharded
+    variant)."""
+    args, parse_ok, gamma_ok, s_ok, pf_arr = _prepare(vks, alphas, proofs)
+    handle = (runner or _default_runner)(*args)
     return handle, parse_ok, gamma_ok, s_ok, pf_arr
 
 
@@ -331,17 +342,22 @@ def batch_verify_vrf(vks, alphas, proofs,
     return _finish(handle, parse_ok, gamma_ok, s_ok, pf_arr, n)
 
 
-def _submit_betas(proofs, m, runner=None):
-    """Parse + dispatch a gamma8 batch; returns (handle, decode_ok)."""
+def _prepare_betas(proofs):
+    """Host-side parse of a gamma8 batch: ((yG, signG), decode_ok)."""
     pf_arr, pf_ok = EJ._bytes_rows(proofs, PROOF_LEN)
     yG, signG, okGc = EJ._decode_compressed(pf_arr[:, :32])
     s_ok = EJ._scalar_lt_L(np.ascontiguousarray(pf_arr[:, 48:80]))
+    return (yG, signG.astype(np.int32)), pf_ok & okGc & s_ok
+
+
+def _submit_betas(proofs, m, runner=None):
+    """Parse + dispatch a gamma8 batch; returns (handle, decode_ok)."""
+    (yG, signG), decode_ok = _prepare_betas(proofs)
     if runner is None:
-        handle = gamma8_kernel(jnp.asarray(yG),
-                               jnp.asarray(signG.astype(np.int32)))
+        handle = gamma8_kernel(jnp.asarray(yG), jnp.asarray(signG))
     else:
-        handle = runner(yG, signG.astype(np.int32))
-    return handle, pf_ok & okGc & s_ok
+        handle = runner(yG, signG)
+    return handle, decode_ok
 
 
 def _finish_betas(rows: np.ndarray, decode_ok, n: int) -> list:

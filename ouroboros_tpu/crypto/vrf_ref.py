@@ -21,19 +21,10 @@ OUTPUT_LEN = 64
 
 
 def _hash_to_curve(vk: bytes, alpha: bytes):
-    """Elligator2 hash-to-curve (draft-03 §5.4.1.2), incl. cofactor clearing."""
-    h = bytearray(ed.sha512(SUITE, b"\x01", vk, alpha)[:32])
-    h[31] &= 0x7F
-    r = int.from_bytes(bytes(h), "little")
-    # Montgomery curve: v^2 = u^3 + A u^2 + u, A = 486662
-    A = ed.A24
-    u = (-A * ed.inv(1 + 2 * r * r % P)) % P
-    w = u * ((u * u + A * u + 1) % P) % P
-    if pow(w, (P - 1) // 2, P) != 1:     # w not a square: take the other root
-        u = (-A - u) % P
-    # birational map Montgomery u -> Edwards y, sign bit 0
-    y = (u - 1) * ed.inv(u + 1) % P
-    pt = ed.decompress(int.to_bytes(y, 32, "little"))
+    """Elligator2 hash-to-curve (draft-03 §5.4.1.2), incl. cofactor
+    clearing.  The field math lives in _hash_to_curve_bytes (shared with
+    the native-ladder prove fast path — one copy of the map)."""
+    pt = ed.decompress(_hash_to_curve_bytes(vk, alpha))
     if pt is None:   # astronomically unlikely for hash output; be total
         pt = BASE
     return ed.scalar_mult(8, pt)         # clear cofactor
@@ -45,7 +36,7 @@ def _hash_points(*pts) -> int:
     return int.from_bytes(c, "little")
 
 
-def prove(sk: bytes, alpha: bytes) -> bytes:
+def prove_pure(sk: bytes, alpha: bytes) -> bytes:
     x, prefix = _secret_expand(sk)
     Y = ed.compress(ed.scalar_mult(x, BASE))
     H = _hash_to_curve(Y, alpha)
@@ -58,9 +49,54 @@ def prove(sk: bytes, alpha: bytes) -> bytes:
         + int.to_bytes(s, 32, "little")
 
 
+def _hash_to_curve_bytes(vk: bytes, alpha: bytes) -> bytes:
+    """Compressed Edwards y (sign 0) of the Elligator2 map, BEFORE
+    cofactor clearing — the shared field-arithmetic half of
+    _hash_to_curve (Montgomery curve v^2 = u^3 + A u^2 + u, A = 486662;
+    non-square w takes the other root; birational map to Edwards y)."""
+    h = bytearray(ed.sha512(SUITE, b"\x01", vk, alpha)[:32])
+    h[31] &= 0x7F
+    r = int.from_bytes(bytes(h), "little")
+    A = ed.A24
+    u = (-A * ed.inv(1 + 2 * r * r % P)) % P
+    w = u * ((u * u + A * u + 1) % P) % P
+    if pow(w, (P - 1) // 2, P) != 1:
+        u = (-A - u) % P
+    y = (u - 1) * ed.inv(u + 1) % P
+    return int.to_bytes(y, 32, "little")
+
+
+def prove(sk: bytes, alpha: bytes) -> bytes:
+    """prove with the four scalar multiplications on the native C ladder
+    when available (identical bytes: the construction is deterministic);
+    prove_pure is the spec and stays the conformance oracle."""
+    from . import cpp_backend as cpp
+    if cpp.shared_library() is None:
+        return prove_pure(sk, alpha)
+    x, prefix = _secret_expand(sk)
+    Y = cpp.scalarmult_base(x)
+    y_h = _hash_to_curve_bytes(Y, alpha)
+    h_string = cpp.scalarmult(y_h, 8)            # clear cofactor
+    if h_string is None:                         # not-on-curve hash output
+        h_string = cpp.scalarmult_base(8)        # the BASE fallback, [8]B
+    Gamma = cpp.scalarmult(h_string, x)
+    k = ed.sha512_int(prefix, h_string) % L
+    kB = cpp.scalarmult_base(k)
+    kH = cpp.scalarmult(h_string, k)
+    c = int.from_bytes(
+        ed.sha512(SUITE, b"\x02", h_string + Gamma + kB + kH)[:16],
+        "little")
+    s = (k + c * x) % L
+    return Gamma + int.to_bytes(c, 16, "little") \
+        + int.to_bytes(s, 32, "little")
+
+
 def public_key(sk: bytes) -> bytes:
     """VRF verification key Y = [x]B for the 32-byte secret seed."""
     x, _ = _secret_expand(sk)
+    from . import cpp_backend as cpp
+    if cpp.shared_library() is not None:
+        return cpp.scalarmult_base(x)
     return ed.compress(ed.scalar_mult(x, BASE))
 
 

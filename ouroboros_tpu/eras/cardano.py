@@ -45,7 +45,8 @@ def translate_ledger_byron_to_shelley(shelley_ledger: ShelleyLedger):
     cfg = shelley_ledger.config
 
     def translate(b: ByronLedgerState) -> ShelleyLedgerState:
-        utxo = tuple(sorted((t, i, a, m, ()) for t, i, a, m in b.utxo))
+        from .shelley import UtxoMap
+        utxo = UtxoMap.from_items((t, i, a, m, ()) for t, i, a, m in b.utxo)
         delegs = tuple(sorted(shelley_ledger.initial_delegs.items()))
         pools = tuple(sorted(shelley_ledger.initial_pools.items()))
         snap = ShelleyLedger._stake_distr(utxo, delegs, pools)

@@ -35,6 +35,7 @@ from __future__ import annotations
 import hashlib
 from dataclasses import dataclass, field, replace
 from fractions import Fraction
+from functools import lru_cache
 from typing import Any, Optional, Sequence
 
 from ..chain.block import Point, point_of
@@ -61,8 +62,11 @@ def _b2b(data: bytes, n: int = 32) -> bytes:
     return hashlib.blake2b(data, digest_size=n).digest()
 
 
+@lru_cache(maxsize=4096)
 def pool_id_of(cold_vk: bytes) -> bytes:
-    """KeyHash of a pool's cold key (Blake2b-224, as in Shelley)."""
+    """KeyHash of a pool's cold key (Blake2b-224, as in Shelley).
+    Memoized: the replay hot path derives it three times per header from
+    a handful of distinct keys."""
     return _b2b(cold_vk, POOL_ID_BYTES)
 
 
@@ -263,6 +267,12 @@ class TPraos(ConsensusProtocol):
 
     # -- header decoding -----------------------------------------------------
     def _decode_header(self, header):
+        """Parse the protocol fields; memoized on the header's own cache —
+        the hot path (sequential_checks + extract_proofs +
+        reupdate_chain_dep_state) decodes each header three times."""
+        got = header._cache.get("tp_dec")
+        if got is not None:
+            return got
         issuer_vk = header.get(ISSUER_FIELD)
         ocert_raw = header.get(OCERT_FIELD)
         pi_eta = header.get(ETA_VRF_FIELD)
@@ -274,7 +284,9 @@ class TPraos(ConsensusProtocol):
             ocert = OCert.from_bytes(ocert_raw)
         except Exception as e:
             raise ProtocolError(f"TPraos: malformed OCert: {e}") from e
-        return issuer_vk, ocert, pi_eta, pi_leader, kes_sig
+        got = (issuer_vk, ocert, pi_eta, pi_leader, kes_sig)
+        header._cache["tp_dec"] = got
+        return got
 
     # -- validation ----------------------------------------------------------
     def sequential_checks(self, ticked: TPraosState, header,
@@ -333,6 +345,13 @@ class TPraos(ConsensusProtocol):
         if pool is None:
             return []
         period = self.kes_period_of(header.slot)
+        c = header._cache
+        kes_msg = c.get("tp_kes_msg")
+        if kes_msg is None:
+            kes_msg = c["tp_kes_msg"] = header.bytes_dropping(KES_FIELD)
+        ocert_body = c.get("tp_ocert_body")
+        if ocert_body is None:
+            ocert_body = c["tp_ocert_body"] = ocert.body_bytes()
         return [
             VrfReq(vk=pool.vrf_vk,
                    alpha=_vrf_alpha(b"eta", header.slot, ticked.eta0),
@@ -340,10 +359,10 @@ class TPraos(ConsensusProtocol):
             VrfReq(vk=pool.vrf_vk,
                    alpha=_vrf_alpha(b"leader", header.slot, ticked.eta0),
                    proof=pi_leader),
-            Ed25519Req(vk=issuer_vk, msg=ocert.body_bytes(), sig=ocert.sigma),
+            Ed25519Req(vk=issuer_vk, msg=ocert_body, sig=ocert.sigma),
             KesReq(depth=cfg.kes_depth, vk=ocert.kes_vk,
                    period=period - ocert.kes_period_start,
-                   msg=header.bytes_dropping(KES_FIELD), sig_bytes=kes_sig),
+                   msg=kes_msg, sig_bytes=kes_sig),
         ]
 
     def vrf_proofs_of(self, headers) -> list:
@@ -516,7 +535,7 @@ def make_shelley_tx(inputs: Sequence, outputs: Sequence, certs: Sequence,
 @dataclass(frozen=True)
 class ShelleyLedgerState:
     """UTxO + delegation map + registered pools + 2-deep stake snapshots."""
-    utxo: tuple              # sorted ((txid, ix, addr, amount, assets), ...)
+    utxo: Any                # UtxoMap: (txid, ix) -> (addr, amount, assets)
     delegs: tuple                      # sorted ((addr, pool_id), ...)
     pools: tuple                       # sorted ((pool_id, vrf_vk), ...)
     epoch: int
@@ -525,9 +544,14 @@ class ShelleyLedgerState:
     slot: int
     tip: Point
 
+    def __post_init__(self):
+        if not isinstance(self.utxo, UtxoMap):
+            # decoders/tests build states from plain 5-tuple sequences
+            object.__setattr__(self, "utxo",
+                               UtxoMap.from_items(self.utxo))
+
     def utxo_dict(self) -> dict:
-        return {(t, i): (a, m, assets)
-                for t, i, a, m, assets in self.utxo}
+        return self.utxo.to_dict()
 
     def state_hash(self) -> bytes:
         enc = cbor.dumps([
@@ -542,10 +566,89 @@ class ShelleyLedgerState:
         return _b2b(enc)
 
 
-def _freeze_utxo(utxo: dict) -> tuple:
-    return tuple(sorted(
-        (t, i, a, m, assets)
-        for (t, i), (a, m, assets) in utxo.items()))
+class UtxoMap:
+    """Persistent UTxO set: immutable view over a shared base dict plus an
+    overlay (adds + deletes), so extending the chain by one block is
+    O(inputs + outputs) instead of O(|UTxO|) — the tuple-freeze
+    representation made a mainnet-scale replay quadratic.  The overlay is
+    flattened into a fresh base every ~|base|/4 mutations, keeping lookup
+    chains one level deep while old states (LedgerDB's k snapshots) stay
+    valid because bases are never mutated in place.
+
+    Iteration yields sorted (txid, ix, addr, amount, assets) 5-tuples —
+    the exact order of the old sorted-tuple representation, so
+    state_hash()es are unchanged."""
+
+    __slots__ = ("_base", "_adds", "_dels")
+
+    def __init__(self, base: dict, adds: dict, dels: frozenset):
+        self._base = base
+        self._adds = adds
+        self._dels = dels
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "UtxoMap":
+        return cls(dict(d), {}, frozenset())
+
+    @classmethod
+    def from_items(cls, items) -> "UtxoMap":
+        return cls({(t, i): (a, m, assets)
+                    for t, i, a, m, assets in items}, {}, frozenset())
+
+    def get(self, key, default=None):
+        v = self._adds.get(key)
+        if v is not None:
+            return v
+        if key in self._dels:
+            return default
+        return self._base.get(key, default)
+
+    def __contains__(self, key) -> bool:
+        if key in self._adds:
+            return True
+        return key not in self._dels and key in self._base
+
+    def to_dict(self) -> dict:
+        d = {k: v for k, v in self._base.items() if k not in self._dels}
+        d.update(self._adds)
+        return d
+
+    def __iter__(self):
+        return iter(sorted((t, i, a, m, assets)
+                           for (t, i), (a, m, assets)
+                           in self.to_dict().items()))
+
+    def __len__(self) -> int:
+        return (len(self._base) + len(self._adds)
+                - sum(1 for k in self._dels if k in self._base))
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, UtxoMap):
+            return self.to_dict() == other.to_dict()
+        return NotImplemented
+
+    __hash__ = None
+
+    def apply(self, spent, added) -> "UtxoMap":
+        """New map with `spent` keys removed and `added` (key, value)
+        pairs inserted — O(delta) amortized."""
+        adds = dict(self._adds)
+        dels = set(self._dels)
+        for k in spent:
+            if adds.pop(k, None) is None:
+                dels.add(k)
+        for k, v in added:
+            adds[k] = v
+            dels.discard(k)
+        if len(adds) + len(dels) > max(64, len(self._base) // 4):
+            base = {k: v for k, v in self._base.items() if k not in dels}
+            base.update(adds)
+            return UtxoMap(base, {}, frozenset())
+        return UtxoMap(self._base, adds, frozenset(dels))
+
+
+def _freeze_utxo(utxo: dict) -> UtxoMap:
+    return UtxoMap.from_dict(utxo)
 
 
 # Shelley-family eras in order; later eras accept earlier features
@@ -603,11 +706,11 @@ class ShelleyLedger(LedgerRules):
                                   -1, Point.genesis())
 
     @staticmethod
-    def _stake_distr(utxo: tuple, delegs: tuple, pools: tuple) -> tuple:
+    def _stake_distr(utxo: "UtxoMap", delegs: tuple, pools: tuple) -> tuple:
         """Aggregate UTxO lovelace per pool through the delegation map
         (native assets carry no stake)."""
         by_addr: dict = {}
-        for _t, _i, addr, amount, _assets in utxo:
+        for addr, amount, _assets in utxo.to_dict().values():
             by_addr[addr] = by_addr.get(addr, 0) + amount
         registered = dict(pools)
         by_pool: dict = {}
@@ -631,10 +734,18 @@ class ShelleyLedger(LedgerRules):
 
     # -- protocol support ----------------------------------------------------
     def ledger_view(self, state: ShelleyLedgerState) -> TPraosLedgerView:
+        # identity-cached on the snap_set tuple: within an epoch every
+        # state shares the same snapshot object, so the per-header replay
+        # path reuses one view instead of rebuilding dict + totals
+        cached = getattr(self, "_view_cache", None)
+        if cached is not None and cached[0] is state.snap_set:
+            return cached[1]
         total = sum(s for _p, s, _v in state.snap_set)
-        return TPraosLedgerView({
+        view = TPraosLedgerView({
             pid: PoolInfo(stake, total, vrf_vk)
             for pid, stake, vrf_vk in state.snap_set})
+        self._view_cache = (state.snap_set, view)
+        return view
 
     def forecast_view(self, state: ShelleyLedgerState,
                       slot: int) -> TPraosLedgerView:
@@ -644,6 +755,9 @@ class ShelleyLedger(LedgerRules):
             raise OutsideForecastRange(
                 f"slot {slot} beyond horizon "
                 f"{state.slot + self.config.stability_window}")
+        if slot // self.config.epoch_length == state.epoch:
+            # same epoch: no snapshot rotation, the view is the state's own
+            return self.ledger_view(state)
         return self.ledger_view(self.tick(state, max(slot, state.slot)))
 
     # -- block application ---------------------------------------------------
@@ -666,9 +780,8 @@ class ShelleyLedger(LedgerRules):
 
     def _apply_txs(self, state: ShelleyLedgerState,
                    block) -> ShelleyLedgerState:
-        utxo = state.utxo_dict()
-        delegs = dict(state.delegs)
-        pools = dict(state.pools)
+        utxo = state.utxo
+        delegs = pools = None          # copied lazily: certs are rare
         for tx in block.body:
             self._check_features(tx, block.slot)
             if len(set(tx.inputs)) != len(tx.inputs):
@@ -677,11 +790,11 @@ class ShelleyLedger(LedgerRules):
             spent = 0
             consumed_assets: dict = {}
             for txid, ix in tx.inputs:
-                key = (txid, ix)
-                if key not in utxo:
+                entry = utxo.get((txid, ix))
+                if entry is None:
                     raise LedgerError(
                         f"missing input {txid.hex()[:12]}#{ix}")
-                _addr, amount, assets = utxo[key]
+                _addr, amount, assets = entry
                 spent += amount
                 for aid, qty in assets:
                     consumed_assets[aid] = consumed_assets.get(aid, 0) + qty
@@ -711,6 +824,9 @@ class ShelleyLedger(LedgerRules):
                     f"tx {tx.txid.hex()[:12]}: asset balance mismatch "
                     f"(consumed+minted != produced)")
             for kind, a, b in tx.certs:
+                if pools is None:
+                    delegs = dict(state.delegs)
+                    pools = dict(state.pools)
                 if kind == CERT_POOL:
                     pools[pool_id_of(a)] = b
                 elif kind == CERT_DELEG:
@@ -721,28 +837,31 @@ class ShelleyLedger(LedgerRules):
                     delegs[a] = b
                 else:
                     raise LedgerError(f"unknown certificate kind {kind!r}")
-            for txid, ix in tx.inputs:
-                del utxo[(txid, ix)]
-            for ix, (addr, amount, assets) in enumerate(tx.outputs):
-                utxo[(tx.txid, ix)] = (addr, amount, assets)
-        return replace(state, utxo=_freeze_utxo(utxo),
-                       delegs=tuple(sorted(delegs.items())),
-                       pools=tuple(sorted(pools.items())),
-                       tip=point_of(block))
+            utxo = utxo.apply(
+                tx.inputs,
+                [((tx.txid, ix), (addr, amount, assets))
+                 for ix, (addr, amount, assets) in enumerate(tx.outputs)])
+        return replace(
+            state, utxo=utxo,
+            delegs=state.delegs if delegs is None
+            else tuple(sorted(delegs.items())),
+            pools=state.pools if pools is None
+            else tuple(sorted(pools.items())),
+            tip=point_of(block))
 
     def check_tx_witnesses(self, state: ShelleyLedgerState,
                            tx: ShelleyTx) -> None:
         """Structural check: every spender, certificate authoriser, and
         minting policy has a witness (validity of the signatures is the
         batchable proof)."""
-        utxo = state.utxo_dict()
+        utxo = state.utxo
         wit_vks = {vk for vk, _ in tx.witnesses}
         for txid, ix in tx.inputs:
-            key = (txid, ix)
-            if key in utxo and utxo[key][0] not in wit_vks:
+            entry = utxo.get((txid, ix))
+            if entry is not None and entry[0] not in wit_vks:
                 raise LedgerError(
                     f"tx {tx.txid.hex()[:12]} spends from "
-                    f"{utxo[key][0].hex()[:12]} without a witness")
+                    f"{entry[0].hex()[:12]} without a witness")
         for kind, a, _b in tx.certs:
             if kind == CERT_POOL and a not in wit_vks:
                 raise LedgerError(

@@ -2,7 +2,13 @@
 
 Multi-chip hardware is not available in CI; shardings are validated on a
 virtual CPU mesh per the driver contract (see __graft_entry__.dryrun_multichip).
-Must run before the first `import jax` anywhere in the test process.
+
+The env var alone is NOT enough on machines where an accelerator plugin
+(axon) registers itself at interpreter start and forces
+jax_platforms="axon,cpu" — tests would silently run on (and contend for)
+the one real TPU chip.  jax.config.update after import wins over the
+plugin, so we do both: env first (covers plugin-free machines before any
+jax import), config update at import time (covers plugin machines).
 """
 import os
 import sys
@@ -13,5 +19,15 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+try:
+    import jax  # noqa: E402  (after the env setup above, by design)
+except ImportError:                          # no jax: the non-jax majority
+    jax = None                               # of the suite still runs
+else:
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.devices()[0].platform == "cpu", (
+        "tests must run on the virtual CPU mesh, not the real chip; got "
+        f"{jax.devices()[0]}")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -6,6 +6,10 @@ import numpy as np
 import pytest
 
 jax = pytest.importorskip("jax")
+
+# full 256-iteration ladder executions: ~minutes through XLA:CPU, so these
+# live in the device partition (`pytest -m device`)
+pytestmark = pytest.mark.device
 import jax.numpy as jnp  # noqa: E402
 
 from ouroboros_tpu.crypto import ed25519_ref  # noqa: E402

@@ -26,6 +26,10 @@ RFC_SIG = bytes.fromhex(
 
 
 def test_rfc8032_vector1():
+    # the PURE implementation is the spec: check it against the RFC
+    # vectors directly, not just the openssl-delegating fast path
+    assert ed25519_ref.public_key_pure(RFC_SK) == RFC_VK
+    assert ed25519_ref.sign_pure(RFC_SK, b"") == RFC_SIG
     assert ed25519_ref.public_key(RFC_SK) == RFC_VK
     assert ed25519_ref.sign(RFC_SK, b"") == RFC_SIG
     assert ed25519_ref.verify(RFC_VK, b"", RFC_SIG)
@@ -58,10 +62,21 @@ def test_cross_check_openssl():
         sk = key.private_bytes(Encoding.Raw, PrivateFormat.Raw, NoEncryption())
         vk = key.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
         msg = f"msg-{i}".encode()
-        # our sign == openssl sign; our verify accepts openssl sig
-        assert ed25519_ref.public_key(sk) == vk
-        assert ed25519_ref.sign(sk, msg) == key.sign(msg)
+        # our PURE sign == openssl sign; our verify accepts openssl sig
+        assert ed25519_ref.public_key_pure(sk) == vk
+        assert ed25519_ref.sign_pure(sk, msg) == key.sign(msg)
         assert ed25519_ref.verify(vk, msg, key.sign(msg))
+
+
+def test_vrf_prove_fast_path_matches_pure():
+    """The native-ladder prove must emit byte-identical proofs to the
+    pure-Python spec (determinism of the draft-03 construction)."""
+    sk = hashlib.sha256(b"vrf-fast").digest()
+    for i in range(3):
+        alpha = b"a%d" % i
+        assert vrf_ref.prove(sk, alpha) == vrf_ref.prove_pure(sk, alpha)
+    assert vrf_ref.public_key(sk) == ed.compress(
+        ed.scalar_mult(vrf_ref._secret_expand(sk)[0], ed.BASE))
 
 
 def test_curve_sanity():

@@ -5,6 +5,11 @@ import pytest
 
 jax = pytest.importorskip("jax")
 
+# shard_map'd ladder kernels over the 8-device CPU mesh: minutes of
+# XLA:CPU work — device partition (`pytest -m device`); the driver's
+# dryrun_multichip covers the sharding path in the default gate
+pytestmark = pytest.mark.device
+
 from ouroboros_tpu.crypto import ed25519_ref  # noqa: E402
 from ouroboros_tpu.parallel import make_mesh, sharded_batch_verify  # noqa: E402
 

@@ -1,0 +1,126 @@
+"""Reference ImmutableDB on-disk format (storage/refformat.py):
+
+- binary layout pinned against hand-computed golden bytes
+  (Impl/Index/Primary.hs:82-136, Secondary.hs:59-135)
+- writer -> reader round trip, incl. EBBs at relative slot 0 and empty
+  slots backfilled in the sparse primary index
+- corrupt-tail truncation on CRC mismatch
+- db_synth --format reference -> db_analyser replay with the same state
+  hash as the native format (the SURVEY §7 P2 interop gate)
+"""
+import hashlib
+import json
+import struct
+import subprocess
+import sys
+from zlib import crc32
+
+import pytest
+
+from ouroboros_tpu.storage import MockFS
+from ouroboros_tpu.storage.refformat import (
+    ENTRY_SIZE, RefDbReader, RefDbWriter, RefEntry, chunk_file,
+    is_reference_db, primary_file, secondary_file,
+)
+
+H1 = hashlib.blake2b(b"one", digest_size=32).digest()
+H2 = hashlib.blake2b(b"two", digest_size=32).digest()
+HE = hashlib.blake2b(b"ebb", digest_size=32).digest()
+
+
+class TestBinaryLayout:
+    def test_secondary_entry_golden_bytes(self):
+        e = RefEntry(block_offset=0x1122334455667788, header_offset=0x0102,
+                     header_size=0x0304, checksum=0xDEADBEEF,
+                     header_hash=H1, slot_or_epoch=42, is_ebb=False)
+        raw = e.encode()
+        assert len(raw) == ENTRY_SIZE == 56
+        assert raw[:8] == bytes.fromhex("1122334455667788")   # Word64 BE
+        assert raw[8:10] == bytes.fromhex("0102")             # Word16 BE
+        assert raw[10:12] == bytes.fromhex("0304")
+        assert raw[12:16] == bytes.fromhex("deadbeef")        # CRC BE
+        assert raw[16:48] == H1
+        assert raw[48:56] == (42).to_bytes(8, "big")
+        assert RefEntry.decode(raw, is_ebb=False) == e
+
+    def test_primary_index_golden_bytes(self):
+        """Chunk size 4, blocks at slots 0 and 2 of chunk 0, no EBB:
+        relative slots are 1 and 3 (slot 0 is the EBB slot), so the
+        offset vector is [0, 0, 56, 56, 112, 112] prefixed by version 1."""
+        fs = MockFS()
+        w = RefDbWriter(fs, chunk_size=4)
+        w.append_block(0, H1, b"AAA")
+        w.append_block(2, H2, b"BBBB")
+        w.close()
+        primary = fs.read_file(primary_file(0))
+        assert primary[0] == 1                                # version
+        offs = struct.unpack(">6I", primary[1:])
+        assert offs == (0, 0, 56, 56, 112, 112)
+        assert fs.read_file(chunk_file(0)) == b"AAABBBB"
+        sec = fs.read_file(secondary_file(0))
+        assert len(sec) == 2 * ENTRY_SIZE
+        e0 = RefEntry.decode(sec[:ENTRY_SIZE], is_ebb=False)
+        assert e0.block_offset == 0 and e0.slot_or_epoch == 0
+        assert e0.checksum == crc32(b"AAA")
+        e1 = RefEntry.decode(sec[ENTRY_SIZE:], is_ebb=False)
+        assert e1.block_offset == 3 and e1.slot_or_epoch == 2
+
+
+class TestRoundTrip:
+    def test_write_read_with_ebb_and_gaps(self):
+        fs = MockFS()
+        w = RefDbWriter(fs, chunk_size=5)
+        # EBB of epoch 0 shares slot 0 with the first regular block
+        w.append_block(0, HE, b"EBB-DATA", is_ebb=True)
+        w.append_block(0, H1, b"BLOCK-0")
+        w.append_block(3, H2, b"BLOCK-3")
+        # chunk 1 (slots 5..9)
+        w.append_block(7, H1, b"BLOCK-7")
+        w.close()
+        assert is_reference_db(fs)
+        got = list(RefDbReader(fs, chunk_size=5))
+        assert [b.data for b in got] == [b"EBB-DATA", b"BLOCK-0",
+                                         b"BLOCK-3", b"BLOCK-7"]
+        assert [b.entry.is_ebb for b in got] == [True, False, False, False]
+        assert got[0].entry.slot(0, 5) == 0       # EBB at epoch boundary
+        assert [b.entry.slot(b.chunk_no, 5) for b in got] == [0, 0, 3, 7]
+
+    def test_corrupt_tail_truncates(self):
+        fs = MockFS()
+        w = RefDbWriter(fs, chunk_size=10)
+        w.append_block(0, H1, b"GOOD-BLOCK")
+        w.append_block(1, H2, b"BAD-BLOCK!")
+        w.close()
+        blob = bytearray(fs.read_file(chunk_file(0)))
+        blob[-1] ^= 0xFF
+        fs.write_file(chunk_file(0), bytes(blob))
+        got = list(RefDbReader(fs, chunk_size=10))
+        assert [b.data for b in got] == [b"GOOD-BLOCK"]
+
+
+class TestSynthAnalyserInterop:
+    @pytest.mark.parametrize("protocol", ["shelley"])
+    def test_reference_format_replay_parity(self, tmp_path, protocol):
+        """Same chain written in both dialects replays to the same state
+        hash through db_analyser."""
+        repo = __file__.rsplit("/tests/", 1)[0]
+        outs = {}
+        for fmt in ("native", "reference"):
+            d = tmp_path / fmt
+            r = subprocess.run(
+                [sys.executable, f"{repo}/tools/db_synth.py", "--out",
+                 str(d), "--protocol", protocol, "--blocks", "30",
+                 "--txs-per-block", "1", "--epoch-length", "40",
+                 "--pools", "2", "--f", "4/5", "--format", fmt,
+                 "--seed", "interop"],
+                capture_output=True, text=True)
+            assert r.returncode == 0, r.stderr[-1500:]
+            a = subprocess.run(
+                [sys.executable, f"{repo}/tools/db_analyser.py", str(d),
+                 "--analysis", "validate", "--validate", "full",
+                 "--backend", "openssl"],
+                capture_output=True, text=True)
+            assert a.returncode == 0, a.stderr[-1500:]
+            outs[fmt] = json.loads(a.stdout.strip().splitlines()[-1])
+        assert outs["native"]["state_hash"] == outs["reference"]["state_hash"]
+        assert outs["native"]["blocks"] == outs["reference"]["blocks"] == 30

@@ -92,7 +92,68 @@ def test_pipelined_seq_error_index(chain):
     assert res.n_valid == 10
 
 
-@pytest.mark.slow
+class AsyncStubBackend(OpensslBackend):
+    """submit/finish-capable CPU backend: exercises the two-deep in-flight
+    window pipeline (drain ordering, beta carry, failure indices) without
+    a device.  Verification is deferred to finish_window, like the real
+    async path."""
+
+    def __init__(self):
+        self.submitted = 0
+        self.finished = 0
+        self.max_in_flight = 0
+
+    def submit_window(self, reqs, next_beta_proofs=()):
+        self.submitted += 1
+        self.max_in_flight = max(self.max_in_flight,
+                                 self.submitted - self.finished)
+        return {"reqs": list(reqs),
+                "beta_proofs": list(dict.fromkeys(next_beta_proofs))}
+
+    def finish_window(self, state):
+        self.finished += 1
+        ok = self.verify_mixed(state["reqs"])
+        betas = dict(zip(state["beta_proofs"],
+                         self.vrf_betas_batch(state["beta_proofs"])))
+        return ok, betas
+
+
+def test_pipelined_two_deep_stub_backend(chain):
+    from ouroboros_tpu.crypto.backend import GLOBAL_BETA_CACHE
+    ext, blocks, final = chain
+    sb = AsyncStubBackend()
+    GLOBAL_BETA_CACHE.clear()
+    res = replay_blocks_pipelined(ext, blocks, ext.initial_state(),
+                                  backend=sb, window=4)
+    assert res.all_valid, res.error
+    assert res.n_valid == len(blocks)
+    assert (res.final_state.ledger.state_hash()
+            == final.ledger.state_hash())
+    # the pipeline really kept two windows in flight
+    assert sb.max_in_flight == 2
+    assert sb.submitted == sb.finished == (len(blocks) + 3) // 4
+
+
+def test_pipelined_two_deep_failure_index(chain):
+    """A bad proof two windows back must still report the EARLIEST bad
+    block index even though later windows were submitted optimistically."""
+    from ouroboros_tpu.crypto.backend import GLOBAL_BETA_CACHE
+    ext, blocks, _final = chain
+    bad_ix = 5
+    blk = blocks[bad_ix]
+    sig = bytearray(blk.header.get(KES_FIELD))
+    sig[3] ^= 1
+    tampered = list(blocks)
+    tampered[bad_ix] = ProtocolBlock(
+        blk.header.with_fields(**{KES_FIELD: bytes(sig)}), blk.body)
+    GLOBAL_BETA_CACHE.clear()
+    res = replay_blocks_pipelined(ext, tampered, ext.initial_state(),
+                                  backend=AsyncStubBackend(), window=4)
+    assert not res.all_valid
+    assert res.n_valid <= bad_ix + 1
+
+
+@pytest.mark.device
 def test_pipelined_jax_backend_matches(chain):
     jax = pytest.importorskip("jax")
     from ouroboros_tpu.crypto.jax_backend import JaxBackend

@@ -65,8 +65,7 @@ def load_db(db_dir: str):
             cfg["nodes"], epoch_length=cfg["epoch_length"],
             seed=cfg["seed"].encode())
         fs = IoFS(db_dir)
-        db = ImmutableDB.open(fs, cfg.get("chunk_size", 100),
-                              validate_all=False)
+        db = _open_immutable(fs, cfg)
 
         def decode_cardano(raw: bytes):
             return cardano_block_decode(cbor.loads(raw))
@@ -98,13 +97,26 @@ def load_db(db_dir: str):
 
     rules = ExtLedgerRules(protocol, ledger)
     fs = IoFS(db_dir)
-    db = ImmutableDB.open(fs, cfg.get("chunk_size", 100),
-                          validate_all=False)
+    db = _open_immutable(fs, cfg)
 
     def decode(raw: bytes) -> ProtocolBlock:
         return ProtocolBlock.decode(cbor.loads(raw), tx_decode=tx_decode)
 
     return db, rules, decode, cfg
+
+
+def _open_immutable(fs, cfg):
+    """Open either on-disk dialect: the reference's .primary/.secondary/
+    .chunk layout (refformat.py; Impl/Index/{Primary,Secondary}.hs) is
+    auto-detected by the presence of .primary index files, else our native
+    CBOR-indexed ImmutableDB."""
+    from ouroboros_tpu.storage import refformat
+    from ouroboros_tpu.storage.immutabledb import ImmutableDB
+    if refformat.is_reference_db(fs):
+        return refformat.RefImmutableView(
+            refformat.RefDbReader(fs, cfg.get("chunk_size", 100)))
+    return ImmutableDB.open(fs, cfg.get("chunk_size", 100),
+                            validate_all=False)
 
 
 def make_backend(name: str):

@@ -30,6 +30,39 @@ from fractions import Fraction
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def open_out_db(fs, args):
+    """The output store: our native ImmutableDB, or a reference-format
+    writer (`--format reference`: the .primary/.secondary/.chunk dialect of
+    Impl/Index/{Primary,Secondary}.hs) behind the same append_block shape."""
+    from ouroboros_tpu.storage.immutabledb import ImmutableDB
+    if getattr(args, "format", "native") != "reference":
+        return ImmutableDB.open(fs, args.chunk_size, validate_all=False)
+
+    from ouroboros_tpu.storage.refformat import RefDbWriter
+    from ouroboros_tpu.utils import cbor as _cbor
+
+    class _RefShim:
+        """ImmutableDB.append_block signature over RefDbWriter, computing
+        the header-within-block span the secondary entries record."""
+
+        def __init__(self):
+            self._w = RefDbWriter(fs, args.chunk_size)
+
+        def append_block(self, slot, block_no, h, prev_hash, data,
+                         is_ebb=False):
+            obj = _cbor.loads(data)
+            hdr_enc = _cbor.dumps(obj[0])
+            off = data.find(hdr_enc)
+            self._w.append_block(slot, h, data, is_ebb=is_ebb,
+                                 header_offset=max(off, 0),
+                                 header_size=len(hdr_enc))
+
+        def close(self):
+            self._w.close()
+
+    return _RefShim()
+
+
 def synth_mock_praos(args) -> dict:
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey,
@@ -85,7 +118,7 @@ def synth_mock_praos(args) -> dict:
         }, fh, indent=2)
 
     fs = IoFS(args.out)
-    db = ImmutableDB.open(fs, args.chunk_size, validate_all=False)
+    db = open_out_db(fs, args)
 
     # spendable outputs per node, seeded from the genesis pseudo-tx whose
     # outputs MockLedger indexes in sorted(vk) order
@@ -137,6 +170,8 @@ def synth_mock_praos(args) -> dict:
             print(f"  forged {forged}/{args.blocks} "
                   f"({forged / (time.time() - t0):.0f} blocks/s)",
                   file=sys.stderr)
+    if hasattr(db, "close"):
+        db.close()              # flush the reference-format tail chunk
     return {"blocks": forged, "last_slot": slot - 1}
 
 
@@ -188,7 +223,7 @@ def synth_shelley(args) -> dict:
         }, fh, indent=2)
 
     fs = IoFS(args.out)
-    db = ImmutableDB.open(fs, args.chunk_size, validate_all=False)
+    db = open_out_db(fs, args)
 
     ext = ExtLedgerRules(protocol, ledger)
     state = ext.initial_state()
@@ -243,6 +278,8 @@ def synth_shelley(args) -> dict:
             print(f"  forged {forged}/{args.blocks} "
                   f"({forged / (time.time() - t0):.0f} blocks/s)",
                   file=sys.stderr)
+    if hasattr(db, "close"):
+        db.close()              # flush the reference-format tail chunk
     return {"blocks": forged, "last_slot": slot - 1}
 
 
@@ -275,7 +312,7 @@ def synth_cardano(args) -> dict:
             "fork_epoch": fork_epoch, "chunk_size": args.chunk_size,
         }, fh, indent=2)
     fs = IoFS(args.out)
-    db = ImmutableDB.open(fs, args.chunk_size, validate_all=False)
+    db = open_out_db(fs, args)
 
     byron_era, shelley_era = eras
     state = rules.initial_state()
@@ -342,6 +379,8 @@ def synth_cardano(args) -> dict:
             print(f"  forged {forged}/{args.blocks} "
                   f"({forged / (time.time() - t0):.0f} blocks/s)",
                   file=sys.stderr)
+    if hasattr(db, "close"):
+        db.close()              # flush the reference-format tail chunk
     return {"blocks": forged, "last_slot": slot - 1,
             "fork_epoch": fork_epoch}
 
@@ -362,6 +401,9 @@ def main() -> None:
     ap.add_argument("--epoch-length", type=int, default=500)
     ap.add_argument("--kes-depth", type=int, default=10)
     ap.add_argument("--chunk-size", type=int, default=100)
+    ap.add_argument("--format", default="native",
+                    choices=["native", "reference"],
+                    help="on-disk dialect: our CBOR-indexed ImmutableDB or the reference .primary/.secondary layout")
     ap.add_argument("--seed", default="db-synth")
     args = ap.parse_args()
 
