@@ -164,7 +164,7 @@ class JaxBackend(CryptoBackend):
                 parts.append(PK._gamma8_call(*beta_args, nb).reshape(-1))
             return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
-        fn = call if PK._interpret() else jax.jit(call)
+        fn = jax.jit(call)
         self._composites[key] = fn
         return fn
 

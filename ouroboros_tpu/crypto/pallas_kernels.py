@@ -146,10 +146,10 @@ def _ed25519_verify_call(yA, signA2d, yR, signR2d, s_bits, k_bits, n: int):
         )(yA, signA2d, yR, signR2d, s_bits, k_bits)
 
 
-# jit on the real device (an un-jitted pallas_call re-lowers and re-compiles
-# on EVERY invocation through the axon remote-compile path — ~60s/call for
-# this kernel); interpret mode must stay un-jitted (jit-of-interpret crashes
-# XLA:CPU).
+# always jitted: an un-jitted pallas_call re-lowers and re-compiles on
+# EVERY invocation (~60s/call for this kernel through the accelerator
+# tunnel's remote-compile path), and jit-of-interpret compiles the
+# interpreted kernel into one XLA:CPU program off-chip
 _ed25519_verify_jit = jax.jit(_ed25519_verify_call,
                               static_argnames=("n",))
 
@@ -157,9 +157,8 @@ _ed25519_verify_jit = jax.jit(_ed25519_verify_call,
 def ed25519_verify_pallas(yA, signA, yR, signR, s_bits, k_bits, n: int):
     """Batched Ed25519 verify, pallas path.  Inputs as in
     ed25519_jax.verify_full_core; n must be a multiple of TILE."""
-    call = _ed25519_verify_call if _interpret() else _ed25519_verify_jit
-    return call(yA, signA.reshape(1, -1), yR, signR.reshape(1, -1),
-                s_bits, k_bits, n)
+    return _ed25519_verify_jit(yA, signA.reshape(1, -1), yR,
+                               signR.reshape(1, -1), s_bits, k_bits, n)
 
 
 # ---------------------------------------------------------------------------
@@ -298,11 +297,11 @@ _vrf_verify_jit = jax.jit(_vrf_verify_call, static_argnames=("n",))
 def vrf_verify_pallas(yY, signY, yG, signG, r, c_bits, lo_bits, hi_bits):
     """vrf_jax runner signature (drop-in for _submit's `runner` arg)."""
     n = yY.shape[1]
-    call = _vrf_verify_call if _interpret() else _vrf_verify_jit
-    return call(jnp.asarray(yY), jnp.asarray(signY).reshape(1, -1),
-                jnp.asarray(yG), jnp.asarray(signG).reshape(1, -1),
-                jnp.asarray(r), jnp.asarray(c_bits), jnp.asarray(lo_bits),
-                jnp.asarray(hi_bits), n)
+    return _vrf_verify_jit(
+        jnp.asarray(yY), jnp.asarray(signY).reshape(1, -1),
+        jnp.asarray(yG), jnp.asarray(signG).reshape(1, -1),
+        jnp.asarray(r), jnp.asarray(c_bits), jnp.asarray(lo_bits),
+        jnp.asarray(hi_bits), n)
 
 
 # ---------------------------------------------------------------------------
@@ -346,8 +345,8 @@ _gamma8_jit = jax.jit(_gamma8_call, static_argnames=("n",))
 def gamma8_pallas(yG, signG):
     """vrf_jax._submit_betas runner signature."""
     n = yG.shape[1]
-    call = _gamma8_call if _interpret() else _gamma8_jit
-    return call(jnp.asarray(yG), jnp.asarray(signG).reshape(1, -1), n)
+    return _gamma8_jit(jnp.asarray(yG), jnp.asarray(signG).reshape(1, -1),
+                       n)
 
 
 def batch_verify_ed25519(vks, msgs, sigs) -> list[bool]:

@@ -34,7 +34,15 @@ from .shelley import (
     TPraosState, shelley_genesis_setup,
 )
 
-BYRON, SHELLEY = 0, 1
+BYRON, SHELLEY, ALLEGRA, MARY = 0, 1, 2, 3
+
+
+def trigger_at_epoch(epoch: int):
+    """TriggerHardForkAtEpoch analog (the reference's protocolInfoCardano
+    per-era trigger, Cardano/Node.hs): the era's exit epoch is fixed by
+    configuration rather than read from on-chain votes — the mechanism
+    testnets (and our synthetic chains) use for the intra-Shelley forks."""
+    return lambda _ledger_state: epoch
 
 
 def translate_ledger_byron_to_shelley(shelley_ledger: ShelleyLedger):
@@ -72,11 +80,26 @@ def translate_chain_dep_byron_to_shelley(genesis_seed: bytes):
 def cardano_eras(byron_protocol: ByronPBft, byron_ledger: ByronLedger,
                  shelley_protocol: TPraos, shelley_ledger: ShelleyLedger,
                  byron_slot_length: float = 1.0,
-                 shelley_slot_length: float = 0.5) -> list:
-    """The two-era list (CardanoEras analog).  Epoch lengths come from the
-    era configs; slot lengths may differ across the fork (the mainnet
-    20s -> 1s change, scaled)."""
-    return [
+                 shelley_slot_length: float = 0.5,
+                 allegra_epoch: Optional[int] = None,
+                 mary_epoch: Optional[int] = None) -> list:
+    """The era list (CardanoEras analog, Cardano/Block.hs:161-186:
+    Byron, Shelley, Allegra, Mary).  Epoch lengths come from the era
+    configs; slot lengths may differ across the Byron fork (the mainnet
+    20s -> 1s change, scaled).
+
+    The intra-Shelley hops (CanHardFork.hs:365-422) keep the TPraos
+    protocol and carry ledger + chain-dep state across unchanged (our
+    ShelleyLedgerState is one type for the whole family; the rules object
+    gates the per-era tx features: validity intervals from Allegra,
+    multi-asset from Mary).  They fire at configured epochs
+    (trigger_at_epoch); pass None to stop the ladder earlier."""
+    if mary_epoch is not None and allegra_epoch is None:
+        raise ValueError("mary_epoch requires allegra_epoch: the era "
+                         "ladder cannot skip Allegra")
+    s_params = EraParams(shelley_protocol.config.epoch_length,
+                         shelley_slot_length)
+    eras = [
         Era("byron", byron_protocol, byron_ledger,
             EraParams(byron_protocol.epoch_length, byron_slot_length),
             transition_epoch=byron_transition_epoch,
@@ -84,16 +107,29 @@ def cardano_eras(byron_protocol: ByronPBft, byron_ledger: ByronLedger,
                 shelley_ledger),
             translate_chain_dep=translate_chain_dep_byron_to_shelley(
                 shelley_protocol.genesis_seed)),
-        Era("shelley", shelley_protocol, shelley_ledger,
-            EraParams(shelley_protocol.config.epoch_length,
-                      shelley_slot_length)),
+        Era("shelley", shelley_protocol, shelley_ledger, s_params,
+            transition_epoch=(trigger_at_epoch(allegra_epoch)
+                              if allegra_epoch is not None else None)),
     ]
+    if allegra_epoch is not None:
+        eras.append(Era(
+            "allegra", shelley_protocol, shelley_ledger.with_era("allegra"),
+            s_params,
+            transition_epoch=(trigger_at_epoch(mary_epoch)
+                              if mary_epoch is not None else None)))
+        if mary_epoch is not None:
+            eras.append(Era(
+                "mary", shelley_protocol, shelley_ledger.with_era("mary"),
+                s_params))
+    return eras
 
 
 def cardano_setup(n_nodes: int, epoch_length: int = 20,
                   shelley_config: Optional[TPraosConfig] = None,
                   seed: bytes = b"cardano-net",
-                  funds_per_key: int = 1000):
+                  funds_per_key: int = 1000,
+                  allegra_epoch: Optional[int] = None,
+                  mary_epoch: Optional[int] = None):
     """Keys + eras for an n-node network that can cross the fork.
 
     Every node holds both a Byron genesis/delegate key pair and a Shelley
@@ -124,7 +160,8 @@ def cardano_setup(n_nodes: int, epoch_length: int = 20,
         genesis, shelley_config,
         initial_pools=dict(s_ledger_tmp.initial_pools),
         initial_delegs=dict(s_ledger_tmp.initial_delegs))
-    eras = cardano_eras(b_protocol, b_ledger, s_protocol, s_ledger)
+    eras = cardano_eras(b_protocol, b_ledger, s_protocol, s_ledger,
+                        allegra_epoch=allegra_epoch, mary_epoch=mary_epoch)
     nodes = []
     for i in range(n_nodes):
         nodes.append({**b_nodes[i], **s_pools[i], "index": i})

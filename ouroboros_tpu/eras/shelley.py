@@ -619,7 +619,10 @@ class UtxoMap:
                            in self.to_dict().items()))
 
     def __len__(self) -> int:
-        return (len(self._base) + len(self._adds)
+        # adds that shadow a live base entry are overwrites, not new keys
+        extra = sum(1 for k in self._adds
+                    if k not in self._base or k in self._dels)
+        return (len(self._base) + extra
                 - sum(1 for k in self._dels if k in self._base))
 
     def __eq__(self, other) -> bool:
@@ -635,8 +638,11 @@ class UtxoMap:
         adds = dict(self._adds)
         dels = set(self._dels)
         for k in spent:
-            if adds.pop(k, None) is None:
-                dels.add(k)
+            # ALWAYS record the delete: popping only the overlay entry
+            # would resurrect a stale base entry if the same outpoint was
+            # deleted, re-created, and spent again
+            adds.pop(k, None)
+            dels.add(k)
         for k, v in added:
             adds[k] = v
             dels.discard(k)
@@ -684,6 +690,13 @@ class ShelleyLedger(LedgerRules):
         self.initial_delegs = dict(initial_delegs or {})
         self.era = era
         self._era_ix = SHELLEY_FAMILY.index(era)
+
+    def with_era(self, era: str) -> "ShelleyLedger":
+        """Same genesis/config under a later era's feature gates — how the
+        HFC composes Allegra/Mary over the shared Shelley machinery (the
+        reference's ShelleyBasedEra reuse, CanHardFork.hs:365-422)."""
+        return ShelleyLedger(self.genesis, self.config, self.initial_pools,
+                             self.initial_delegs, era=era)
 
     @property
     def supports_validity(self) -> bool:

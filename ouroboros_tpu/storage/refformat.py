@@ -144,9 +144,16 @@ class RefDbWriter:
     """Streaming writer: append blocks in chain order, chunks are emitted
     as they fill (db_synth --format reference)."""
 
-    def __init__(self, fs: FsApi, chunk_size: int):
+    def __init__(self, fs: FsApi, chunk_size: int,
+                 epoch_length: Optional[int] = None):
+        """epoch_length, when known, is validated on the first EBB: the
+        reference's EBB layout identifies chunks with epochs (EBB of epoch
+        N at relative slot 0 of chunk N), so EBB-bearing chains need
+        chunk_size == epoch_length or the on-disk epochNo would be wrong.
+        EBB-free chains (Shelley-only) may use any chunk size."""
         self.fs = fs
         self.chunk_size = chunk_size
+        self.epoch_length = epoch_length
         self._cur: Optional[RefChunkWriter] = None
         fs.mkdirs(DIR)
 
@@ -161,6 +168,13 @@ class RefDbWriter:
     def append_block(self, slot: int, header_hash: bytes, data: bytes,
                      is_ebb: bool = False, header_offset: int = 0,
                      header_size: int = 0) -> None:
+        if is_ebb and self.epoch_length is not None \
+                and self.epoch_length != self.chunk_size:
+            raise ValueError(
+                f"reference format with EBBs requires chunk_size == "
+                f"epoch_length (got {self.chunk_size} vs "
+                f"{self.epoch_length}); pass --chunk-size equal to the "
+                f"epoch length")
         n = (slot // self.chunk_size)
         self._chunk_for(n).append(slot, header_hash, data, is_ebb,
                                   header_offset, header_size)

@@ -22,7 +22,7 @@ from ouroboros_tpu.eras.byron import (
     CERT_UPDATE, ByronLedgerState, byron_sign_header, make_byron_tx,
 )
 from ouroboros_tpu.eras.cardano import (
-    BYRON, SHELLEY, cardano_block_decode, cardano_setup,
+    ALLEGRA, BYRON, MARY, SHELLEY, cardano_block_decode, cardano_setup,
 )
 from ouroboros_tpu.eras.shelley import (
     ShelleyLedgerState, TPraosState, forge_tpraos_fields,
@@ -128,15 +128,21 @@ def _make_node(i, eras, rules, nodes):
                                              db.tip_point()),
                       backend=BACKEND)
     node = nodes[i]
-    forging = BlockForging(
-        issuer=i,
-        can_be_leader={BYRON: i, SHELLEY: node["can_be_leader"]},
-        forge=hfc_forge(eras, {
-            BYRON: lambda p, proof, hdr, n=node: byron_sign_header(
-                n["delegate_sk"], hdr),
-            SHELLEY: lambda p, proof, hdr, n=node: forge_tpraos_fields(
-                p, n["hot_key"], n["can_be_leader"], proof, hdr),
-        }))
+
+    def tpraos_forge(p, proof, hdr, n=node):
+        return forge_tpraos_fields(p, n["hot_key"], n["can_be_leader"],
+                                   proof, hdr)
+
+    # TPraos leadership/forging is shared by every Shelley-family era
+    # (CanHardFork.hs keeps the protocol across the intra-Shelley hops)
+    cbl = {BYRON: i}
+    forges = {BYRON: lambda p, proof, hdr, n=node: byron_sign_header(
+        n["delegate_sk"], hdr)}
+    for era_ix in range(SHELLEY, len(eras)):
+        cbl[era_ix] = node["can_be_leader"]
+        forges[era_ix] = tpraos_forge
+    forging = BlockForging(issuer=i, can_be_leader=cbl,
+                           forge=hfc_forge(eras, forges))
     btime = HardForkBlockchainTime(
         lambda db=db, ledger=ledger:
             ledger.summary(db.current_ledger.ledger))
@@ -201,3 +207,96 @@ def test_real_era_network_crosses_fork():
     heads = [c.head_block_no for c, _, _ in results]
     assert max(heads) - min(heads) <= 2
     assert min(heads) >= 10
+
+
+def test_era_ladder_crosses_three_boundaries():
+    """Byron -> Shelley -> Allegra -> Mary in ONE run: the reference's
+    4-era composition (Cardano/Block.hs:161-186) with the intra-Shelley
+    hops at configured epochs (TriggerHardForkAtEpoch).  Every node must
+    converge with monotone era tags and end inside Mary."""
+    allegra_epoch, mary_epoch = FORK_EPOCH + 1, FORK_EPOCH + 2
+    eras, rules, nodes = cardano_setup(
+        N_NODES, epoch_length=EPOCH,
+        allegra_epoch=allegra_epoch, mary_epoch=mary_epoch)
+    assert [e.name for e in eras] == ["byron", "shelley", "allegra", "mary"]
+
+    async def main():
+        kernels = [_make_node(i, eras, rules, nodes) for i in range(N_NODES)]
+        for k in kernels:
+            k.start()
+        for i in range(N_NODES):
+            for j in range(i + 1, N_NODES):
+                connect_nodes(kernels[i], kernels[j], delay=0.02)
+        upd = make_byron_tx(
+            inputs=[], outputs=[],
+            certs=[(CERT_UPDATE, FORK_EPOCH.to_bytes(8, "big"), b"")],
+            signing_keys=[nodes[0]["genesis_sk"]])
+        await sim.sleep(0.5)
+        accepted, _rej = kernels[0].mempool.try_add_txs([upd])
+        assert accepted
+        # byron: 20 slots @1s; shelley epoch 2 (10 slots), allegra epoch 3,
+        # mary from epoch 4 — run to ~slot 55 of the 0.5s-slot regime
+        await sim.sleep(20.0 + 18.0 + 1.0)
+        out = []
+        for k in kernels:
+            chain = k.chain_db.current_chain.copy()
+            imm_tags = []
+            for entry, raw in k.chain_db.immutable.stream():
+                imm_tags.append(_block_decode(raw).header.get(ERA_FIELD))
+            out.append((chain, imm_tags, k.chain_db.current_ledger))
+            for t in k._threads:
+                try:
+                    t.poll()
+                except sim.AsyncCancelled:
+                    pass
+                except BaseException as e:
+                    raise AssertionError(
+                        f"{k.label}/{t.label} failed: {e!r}") from e
+            k.stop()
+        return out
+
+    results = sim.run(main(), seed=7)
+    for chain, imm_tags, ext in results:
+        tags = imm_tags + [b.header.get(ERA_FIELD) for b in chain.blocks]
+        for era in (BYRON, SHELLEY, ALLEGRA, MARY):
+            assert era in tags, f"no blocks in era {era}: {tags}"
+        assert tags == sorted(tags), f"era tags not monotone: {tags}"
+        assert ext.ledger.era == MARY
+        assert ext.ledger.transitions == (FORK_EPOCH, allegra_epoch,
+                                          mary_epoch)
+    heads = [c.head_block_no for c, _, _ in results]
+    assert max(heads) - min(heads) <= 2
+
+
+def test_era_feature_gating_in_ladder():
+    """A Mary-only mint tx must be REJECTED by the Allegra-era rules and
+    accepted once the ladder reaches Mary (the per-pair translations +
+    feature gates of CanHardFork.hs:365-422)."""
+    from ouroboros_tpu.consensus.ledger import LedgerError
+    from ouroboros_tpu.eras.shelley import make_shelley_tx, pool_id_of
+    eras, rules, nodes = cardano_setup(
+        2, epoch_length=EPOCH, allegra_epoch=FORK_EPOCH + 1,
+        mary_epoch=FORK_EPOCH + 2)
+    allegra_rules = eras[ALLEGRA].ledger
+    mary_rules = eras[MARY].ledger
+    addr = nodes[0]["addr"]
+    sk = nodes[0]["keys"].addr_sk
+    aid = pool_id_of(addr)
+    # a ledger state inside the Shelley family with the genesis funds
+    st = eras[SHELLEY].ledger.initial_state()
+    entry = next(u for u in st.utxo if u[2] == addr)
+    mint_tx = make_shelley_tx(
+        inputs=[(entry[0], entry[1])],
+        outputs=[(addr, entry[3] - 1), (addr, 1, ((aid, 5),))],
+        certs=[], signing_keys=[sk], mint=[(aid, 5)])
+    with pytest.raises(LedgerError, match="multi-asset"):
+        allegra_rules.apply_tx(st, mint_tx, backend=BACKEND)
+    out = mary_rules.apply_tx(st, mint_tx, backend=BACKEND)
+    assert any(u[4] for u in out.utxo), "minted asset missing from UTxO"
+    # and a validity-interval tx needs Allegra+: Shelley rejects it
+    val_tx = make_shelley_tx(
+        inputs=[(entry[0], entry[1])], outputs=[(addr, entry[3])],
+        certs=[], signing_keys=[sk], validity=(-1, 10_000))
+    with pytest.raises(LedgerError, match="validity"):
+        eras[SHELLEY].ledger.apply_tx(st, val_tx, backend=BACKEND)
+    allegra_rules.apply_tx(st, val_tx, backend=BACKEND)

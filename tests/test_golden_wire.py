@@ -1,0 +1,191 @@
+"""Golden wire-format tests — pin every mini-protocol message encoding and
+the per-era block/tx encodings so a refactor cannot silently change bytes
+on the wire or on disk.
+
+The reference pins its codecs against a CDDL spec plus golden byte files
+(ouroboros-network/test-cddl/Main.hs + test/messages.cddl;
+Test/Util/Serialisation/Golden.hs).  Here each protocol contributes a
+deterministic sample corpus; the SHA-256 of the concatenated encodings is
+pinned, plus full hex for a few small messages so a failure is readable.
+
+Regenerate after an INTENTIONAL format change with:
+    python tests/test_golden_wire.py --regen
+"""
+import hashlib
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ouroboros_tpu.chain.block import Point, Tip
+from ouroboros_tpu.network.protocols import (
+    blockfetch as bf, chainsync as cs, handshake as hs, keepalive as ka,
+    localstatequery as lsq, localtxmonitor as ltm, localtxsubmission as lts,
+    tipsample as ts, txsubmission as txs, txsubmission2 as txs2,
+)
+
+H = lambda tag: hashlib.blake2b(tag, digest_size=32).digest()
+P1 = Point(slot=7, hash=H(b"p1"))
+P2 = Point(slot=9, hash=H(b"p2"))
+TIP = Tip(P2, 4)
+
+
+def _corpus():
+    from ouroboros_tpu.consensus.headers import (
+        ProtocolBlock, body_hash_of, make_header,
+    )
+    hdr = make_header(None, 7, (), issuer=1).with_fields(demo=b"\x01\x02")
+    out = {}
+    out["chainsync"] = [
+        cs.MsgRequestNext(), cs.MsgAwaitReply(),
+        cs.MsgRollForward(hdr, TIP), cs.MsgRollBackward(P1, TIP),
+        cs.MsgFindIntersect((P1, P2)), cs.MsgIntersectFound(P1, TIP),
+        cs.MsgIntersectNotFound(TIP), cs.MsgDone(),
+    ]
+    out["blockfetch"] = [
+        bf.MsgRequestRange(P1, P2), bf.MsgClientDone(), bf.MsgStartBatch(),
+        bf.MsgNoBlocks(),
+        bf.MsgBlock(ProtocolBlock(make_header(None, 1, (), issuer=0), ())),
+        bf.MsgBatchDone(),
+    ]
+    out["txsubmission"] = [
+        txs.MsgRequestTxIds(True, 2, 5),
+        txs.MsgReplyTxIds(((H(b"tx1"), 123), (H(b"tx2"), 456))),
+        txs.MsgRequestTxs((H(b"tx1"),)),
+        txs.MsgReplyTxs((b"\x01\x02\x03",)), txs.MsgDone(),
+    ]
+    out["txsubmission2"] = [txs2.MsgHello()] + out["txsubmission"]
+    out["keepalive"] = [
+        ka.MsgKeepAlive(0xBEEF), ka.MsgKeepAliveResponse(0xBEEF),
+        ka.MsgDone(),
+    ]
+    out["handshake"] = [
+        hs.MsgProposeVersions(((7, b"\x0a"), (8, b"\x0b"))),
+        hs.MsgAcceptVersion(8, b"\x0b"),
+        hs.MsgRefuse("VersionMismatch"),
+    ]
+    out["localstatequery"] = [
+        lsq.MsgAcquire(P1), lsq.MsgAcquired(), lsq.MsgFailure("pointTooOld"),
+        lsq.MsgQuery(["get-balance", H(b"addr")]),
+        lsq.MsgResult(12345), lsq.MsgReAcquire(P2), lsq.MsgRelease(),
+        lsq.MsgDone(),
+    ]
+    out["localtxsubmission"] = [
+        lts.MsgSubmitTx(b"\x01\x02"), lts.MsgAcceptTx(),
+        lts.MsgRejectTx("bad witness"), lts.MsgDone(),
+    ]
+    out["localtxmonitor"] = [
+        ltm.MsgRequestTx(), ltm.MsgReplyTx(b"\x05\x06"), ltm.MsgDone(),
+    ]
+    out["tipsample"] = [
+        ts.MsgFollowTip(3, 11), ts.MsgNextTip(TIP), ts.MsgNextTipDone(TIP),
+        ts.MsgDone(),
+    ]
+    return out
+
+
+_CODECS = {
+    "chainsync": cs.CODEC, "blockfetch": bf.CODEC,
+    "txsubmission": txs.CODEC, "txsubmission2": txs2.CODEC,
+    "keepalive": ka.CODEC, "handshake": hs.CODEC,
+    "localstatequery": lsq.CODEC, "localtxsubmission": lts.CODEC,
+    "localtxmonitor": ltm.CODEC, "tipsample": ts.CODEC,
+}
+
+
+def _era_corpus():
+    """Deterministic per-era tx + block encodings (Golden.hs role)."""
+    from ouroboros_tpu.consensus.headers import ProtocolBlock, make_header
+    from ouroboros_tpu.eras.byron import make_byron_tx
+    from ouroboros_tpu.eras.shelley import make_shelley_tx
+    from ouroboros_tpu.ledgers.mock import Tx, TxIn, TxOut
+
+    sk = H(b"golden-sk")
+    gen = b"\x00" * 32
+    stx = make_shelley_tx(
+        inputs=[(gen, 0)], outputs=[(H(b"addr"), 1000)],
+        certs=[], signing_keys=[sk])
+    mtx = make_shelley_tx(
+        inputs=[(gen, 1)],
+        outputs=[(H(b"addr2"), 500, ((H(b"pol")[:28], 3),))],
+        certs=[], signing_keys=[sk], validity=(2, 99),
+        mint=[(H(b"pol")[:28], 3)])
+    btx = make_byron_tx(inputs=[(gen, 0)], outputs=[(H(b"baddr"), 77)],
+                        certs=[], signing_keys=[sk])
+    mock = Tx((TxIn(gen, 0),), (TxOut(H(b"maddr"), 9),))
+    hdr = make_header(None, 3, (stx,), issuer=0)
+    blk = ProtocolBlock(hdr, (stx,))
+    from ouroboros_tpu.utils import cbor
+    return {
+        "shelley_tx": cbor.dumps(stx.encode()),
+        "mary_tx": cbor.dumps(mtx.encode()),
+        "byron_tx": cbor.dumps(btx.encode()),
+        "mock_tx": cbor.dumps(mock.encode()),
+        "protocol_block": blk.bytes,
+    }
+
+
+def digests():
+    out = {}
+    corpus = _corpus()
+    for name, msgs in corpus.items():
+        codec = _CODECS[name]
+        blob = b"".join(codec.encode(m) for m in msgs)
+        out[name] = hashlib.sha256(blob).hexdigest()
+    for name, blob in _era_corpus().items():
+        out[name] = hashlib.sha256(blob).hexdigest()
+    return out
+
+
+EXPECTED = {
+    "chainsync": "cb26b34abee49febbf267a1032e4b26f3dec159bb56ebae3a2a459cd000100f0",
+    "blockfetch": "1260c8b9e1066ae24682676189451deb4d4e15ab465b8a4741db2714a646faca",
+    "txsubmission": "8033c0356409dbec371d1f4506a4a6297890c1a1ba6c26f530098097c83c33e2",
+    "txsubmission2": "0d8ebf4307ad94ada1f3363de80c17849a5d5dcce578ed6479d03dbb0a437931",
+    "keepalive": "07785ca61706e8b8978e443757c8932e5c157b8452480f3c4fbdf18ae98e4240",
+    "handshake": "b28442145e0ba3845b6ada0d5c8fddb58056122cd9834f25fbb018da896af3df",
+    "localstatequery": "b7fc8bc8a88b9e3e0f64ccf7562bfe0d49f35ce9e6eba6318838d0444137c7b5",
+    "localtxsubmission": "2f7ef01c240b2671ab4043d2a0812d747538f26237d4fae48e875c0dbd292e34",
+    "localtxmonitor": "e71b38f3e981217c9bda46ba8e8adb38ce9604a2a31e9c7ce86b14c1a8081d1a",
+    "tipsample": "da67183f7d2501fc3c13a500e7f34409e97264f9ab36529d5c2c3dffd5d7a700",
+    "shelley_tx": "26ff9a02a82c59d0d1b9911d4bf347e9e952c43ced2bf1f8800b8a99c71f1f1c",
+    "mary_tx": "7054a51938dd284ce677aace157160db80f0c168471974d5ea862ab57086aee0",
+    "byron_tx": "93a6e559799eaa7d4fe22efb70e72048fc53b2f4c666a00dec67bd50dd10025f",
+    "mock_tx": "711d5d0203ff4ebf55b092627e8e293ca9d4bedd9968661c76275d8320aa11f5",
+    "protocol_block": "410280a5a1fbb71f4741daeff8f6f6b5f454a26c8951ad88d713e584a81561e5",
+}
+
+
+# Pinned full-hex for small, readable messages (fail output you can eyeball)
+EXPECTED_HEX = {
+    "chainsync_request_next": "8100",
+    "keepalive_cookie": "820019beef",
+    "txsub_request_ids": "8400f50205",
+}
+
+
+def test_small_messages_exact_bytes():
+    assert cs.CODEC.encode(cs.MsgRequestNext()).hex() \
+        == EXPECTED_HEX["chainsync_request_next"]
+    assert ka.CODEC.encode(ka.MsgKeepAlive(0xBEEF)).hex() \
+        == EXPECTED_HEX["keepalive_cookie"]
+    assert txs.CODEC.encode(txs.MsgRequestTxIds(True, 2, 5)).hex() \
+        == EXPECTED_HEX["txsub_request_ids"]
+
+
+def test_corpus_digests_pinned():
+    got = digests()
+    assert EXPECTED, "run: python tests/test_golden_wire.py --regen"
+    mismatches = {k: (EXPECTED.get(k), v) for k, v in got.items()
+                  if EXPECTED.get(k) != v}
+    assert not mismatches, (
+        "wire/disk format changed! If intentional, regenerate with "
+        f"--regen. Mismatches: {mismatches}")
+
+
+if __name__ == "__main__":
+    if "--regen" in sys.argv:
+        for k, v in digests().items():
+            print(f'    "{k}": "{v}",')

@@ -1,0 +1,76 @@
+"""Pallas kernel coverage OFF the real chip: interpret mode runs the
+exact kernel bodies (grids, ref reads, where-selects, byte extraction)
+as traced jax ops, so a bit-exactness regression in the fused ladders is
+caught without TPU hardware.  TILE is shrunk via monkeypatch so the
+interpret run stays small; on a real TPU the same code paths compile
+through Mosaic (exercised by the flagship bench)."""
+import hashlib
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import numpy as np  # noqa: E402
+
+from ouroboros_tpu.crypto import ed25519_ref, vrf_ref  # noqa: E402
+from ouroboros_tpu.crypto import pallas_kernels as PK  # noqa: E402
+
+# full 256-iteration ladders through the pallas interpreter: minutes of
+# XLA:CPU — device partition
+pytestmark = pytest.mark.device
+
+
+@pytest.fixture(autouse=True)
+def small_tile(monkeypatch):
+    monkeypatch.setattr(PK, "TILE", 8)
+    # interpret mode must be on off-chip regardless of platform detection
+    monkeypatch.setattr(PK, "_interpret", lambda: True)
+
+
+def test_ed25519_pallas_interpret_bit_exact():
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+    sk = hashlib.sha256(b"pallas-test").digest()
+    key = Ed25519PrivateKey.from_private_bytes(sk)
+    vk = ed25519_ref.public_key(sk)
+    n = 16                                  # 2 grid steps at TILE=8
+    msgs = [b"m%d" % i for i in range(n)]
+    sigs = [key.sign(m) for m in msgs]
+    bad = {3, 9}
+    sigs = [bytes([s[0] ^ 1]) + s[1:] if i in bad else s
+            for i, s in enumerate(sigs)]
+    ok = PK.batch_verify_ed25519([vk] * n, msgs, sigs)
+    assert ok == [i not in bad for i in range(n)]
+
+
+def test_vrf_pallas_interpret_bit_exact():
+    from ouroboros_tpu.crypto import vrf_jax
+    sk = hashlib.sha256(b"pallas-vrf").digest()
+    vk = vrf_ref.public_key(sk)
+    n = 8
+    alphas = [b"a%d" % i for i in range(n)]
+    proofs = [vrf_ref.prove(sk, a) for a in alphas]
+    bad = {2, 7}
+    proofs = [bytes([p[0] ^ 2]) + p[1:] if i in bad else p
+              for i, p in enumerate(proofs)]
+    state = vrf_jax._submit(
+        [vk] * n, alphas, proofs, n, runner=PK.vrf_verify_pallas)
+    oks, betas = vrf_jax._finish(*state, n)
+    assert oks == [i not in bad for i in range(n)]
+    for i in range(n):
+        if i not in bad:
+            assert betas[i] == vrf_ref.proof_to_hash(proofs[i])
+
+
+def test_gamma8_pallas_interpret_matches_proof_to_hash():
+    from ouroboros_tpu.crypto import vrf_jax
+    sk = hashlib.sha256(b"pallas-g8").digest()
+    proofs = [vrf_ref.prove(sk, b"g%d" % i) for i in range(7)]
+    proofs.append(b"\x00" * 80)             # undecodable
+    handle, decode_ok = vrf_jax._submit_betas(
+        proofs, 8, runner=PK.gamma8_pallas)
+    betas = vrf_jax._finish_betas(np.asarray(handle), decode_ok, 8)
+    for i in range(7):
+        assert betas[i] == vrf_ref.proof_to_hash(proofs[i])
+    assert betas[7] is None

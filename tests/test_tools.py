@@ -158,3 +158,25 @@ def test_cardano_chain_has_both_eras_and_ebbs(cardano_db):
     # EBBs share their successor's slot: expect at least one duplicate slot
     slots = [int(l.split("\t")[0]) for l in r.stdout.strip().splitlines()]
     assert len(slots) != len(set(slots)), "no EBB/successor slot pair"
+
+
+def test_cardano_chain_crosses_the_full_era_ladder(cardano_db):
+    """The synthesized cardano chain spans Byron->Shelley->Allegra->Mary
+    (Cardano/Block.hs:161-186) with the feature txs in the later eras, and
+    full validation replays it."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "dba_t", os.path.join(REPO, "tools", "db_analyser.py"))
+    dba = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(dba)
+    db, rules, decode, cfg = dba.load_db(cardano_db)
+    eras_seen = set()
+    mint = validity = 0
+    for _e, raw in db.stream():
+        b = decode(raw)
+        eras_seen.add(b.header.get("hfc_era", 0))
+        for tx in b.body:
+            mint += bool(getattr(tx, "mint", ()))
+            validity += bool(getattr(tx, "validity", ()))
+    assert eras_seen == {0, 1, 2, 3}, eras_seen
+    assert mint >= 1 and validity >= 1

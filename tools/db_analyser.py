@@ -63,7 +63,9 @@ def load_db(db_dir: str):
         )
         _eras, rules, _nodes = cardano_setup(
             cfg["nodes"], epoch_length=cfg["epoch_length"],
-            seed=cfg["seed"].encode())
+            seed=cfg["seed"].encode(),
+            allegra_epoch=cfg.get("allegra_epoch"),
+            mary_epoch=cfg.get("mary_epoch"))
         fs = IoFS(db_dir)
         db = _open_immutable(fs, cfg)
 

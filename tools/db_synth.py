@@ -46,15 +46,23 @@ def open_out_db(fs, args):
         the header-within-block span the secondary entries record."""
 
         def __init__(self):
-            self._w = RefDbWriter(fs, args.chunk_size)
+            self._w = RefDbWriter(fs, args.chunk_size,
+                                  epoch_length=args.epoch_length)
 
         def append_block(self, slot, block_no, h, prev_hash, data,
                          is_ebb=False):
             obj = _cbor.loads(data)
             hdr_enc = _cbor.dumps(obj[0])
             off = data.find(hdr_enc)
+            if off < 0:
+                # fail loudly at write time: a wrong header span in the
+                # secondary index would only surface as downstream garbage
+                raise RuntimeError(
+                    f"block at slot {slot}: header re-encoding is not a "
+                    f"substring of the block bytes; cannot record the "
+                    f"header span in the reference secondary index")
             self._w.append_block(slot, h, data, is_ebb=is_ebb,
-                                 header_offset=max(off, 0),
+                                 header_offset=off,
                                  header_size=len(hdr_enc))
 
         def close(self):
@@ -75,7 +83,6 @@ def synth_mock_praos(args) -> dict:
     from ouroboros_tpu.crypto import ed25519_ref, kes as kes_mod
     from ouroboros_tpu.ledgers.mock import Tx, TxIn, TxOut
     from ouroboros_tpu.storage.fs import IoFS
-    from ouroboros_tpu.storage.immutabledb import ImmutableDB
 
     seed = args.seed.encode()
 
@@ -190,7 +197,6 @@ def synth_shelley(args) -> dict:
         shelley_genesis_setup,
     )
     from ouroboros_tpu.storage.fs import IoFS
-    from ouroboros_tpu.storage.immutabledb import ImmutableDB
 
     f = Fraction(args.f)
     # KES periods must cover the whole chain
@@ -284,42 +290,54 @@ def synth_shelley(args) -> dict:
 
 
 def synth_cardano(args) -> dict:
-    """Forge a Byron->Shelley chain crossing the hard fork (BASELINE
-    config #5 shape): PBFT blocks + EBBs, a Byron update proposal naming
-    the fork epoch, then TPraos blocks — all through the combinator."""
+    """Forge a chain crossing the full era ladder (BASELINE config #5
+    shape, now Byron->Shelley->Allegra->Mary per Cardano/Block.hs:161-186):
+    PBFT blocks + EBBs, a Byron update proposal naming the Shelley fork
+    epoch, TPraos blocks, then configured-epoch hops into Allegra (a
+    validity-interval tx exercises the timelock gate) and Mary (a minting
+    tx exercises multi-asset) — all through the combinator."""
     from ouroboros_tpu.consensus.hardfork.combinator import ERA_FIELD
     from ouroboros_tpu.consensus.headers import ProtocolBlock, make_header
     from ouroboros_tpu.eras.byron import (
         CERT_UPDATE, byron_sign_header, make_byron_tx, make_ebb,
     )
     from ouroboros_tpu.eras.cardano import (
-        BYRON, SHELLEY, cardano_setup,
+        ALLEGRA, BYRON, MARY, SHELLEY, cardano_setup,
     )
-    from ouroboros_tpu.eras.shelley import forge_tpraos_fields
+    from ouroboros_tpu.eras.shelley import (
+        forge_tpraos_fields, make_shelley_tx, pool_id_of,
+    )
     from ouroboros_tpu.storage.fs import IoFS
-    from ouroboros_tpu.storage.immutabledb import ImmutableDB
 
     epoch_length = args.epoch_length
-    fork_epoch = max(1, args.blocks // (2 * epoch_length))
+    total_epochs = max(8, args.blocks // epoch_length)
+    # Byron spans >= 2 epochs so the chain contains an EBB with a same-slot
+    # Byron successor (the EBB layout the storage layer must handle)
+    fork_epoch = max(2, total_epochs // 4)
+    allegra_epoch = fork_epoch + max(1, total_epochs // 4)
+    mary_epoch = allegra_epoch + max(1, total_epochs // 4)
     eras, rules, nodes = cardano_setup(
-        args.pools, epoch_length=epoch_length, seed=args.seed.encode())
+        args.pools, epoch_length=epoch_length, seed=args.seed.encode(),
+        allegra_epoch=allegra_epoch, mary_epoch=mary_epoch)
 
     os.makedirs(args.out, exist_ok=True)
     with open(os.path.join(args.out, "config.json"), "w") as fh:
         json.dump({
             "protocol": "cardano", "nodes": args.pools,
             "epoch_length": epoch_length, "seed": args.seed,
-            "fork_epoch": fork_epoch, "chunk_size": args.chunk_size,
+            "fork_epoch": fork_epoch, "allegra_epoch": allegra_epoch,
+            "mary_epoch": mary_epoch, "chunk_size": args.chunk_size,
         }, fh, indent=2)
     fs = IoFS(args.out)
     db = open_out_db(fs, args)
 
-    byron_era, shelley_era = eras
+    byron_era, shelley_era = eras[0], eras[1]
     state = rules.initial_state()
     prev = None
     slot = 0
     forged = 0
     update_sent = False
+    feature_todo = {ALLEGRA, MARY}      # one feature tx per new era
     t0 = time.time()
 
     def append(blk):
@@ -355,6 +373,7 @@ def synth_cardano(args) -> dict:
             hdr = byron_sign_header(node["delegate_sk"], hdr)
             blk = ProtocolBlock(hdr, tuple(body))
         else:
+            era_ix = ticked_dep.era
             lead = node = None
             for node in nodes:
                 lead = shelley_era.protocol.check_is_leader(
@@ -365,11 +384,36 @@ def synth_cardano(args) -> dict:
             if lead is None:
                 slot += 1
                 continue
-            hdr = make_header(prev, slot, (), issuer=0)
-            hdr = hdr.with_fields(**{ERA_FIELD: SHELLEY})
+            # one feature tx per era entry: Allegra's validity interval,
+            # Mary's mint — spending the forger's own crossing UTxO
+            body = []
+            if era_ix in feature_todo:
+                owner_addr = node["addr"]
+                entry = next((u for u in state.ledger.inner.utxo
+                              if u[2] == owner_addr and not u[4]), None)
+                if entry is not None:
+                    t, i, _a, amt, _assets = entry
+                    addr_vk = owner_addr
+                    if era_ix == ALLEGRA:
+                        tx = make_shelley_tx(
+                            inputs=[(t, i)], outputs=[(owner_addr, amt)],
+                            certs=[], signing_keys=[node["keys"].addr_sk],
+                            validity=(0, slot + epoch_length))
+                    else:                       # MARY: mint a native asset
+                        aid = pool_id_of(addr_vk)
+                        tx = make_shelley_tx(
+                            inputs=[(t, i)],
+                            outputs=[(owner_addr, amt - 1),
+                                     (owner_addr, 1, ((aid, 5),))],
+                            certs=[], signing_keys=[node["keys"].addr_sk],
+                            mint=[(aid, 5)])
+                    body.append(tx)
+                    feature_todo.discard(era_ix)
+            hdr = make_header(prev, slot, body, issuer=0)
+            hdr = hdr.with_fields(**{ERA_FIELD: era_ix})
             hdr = forge_tpraos_fields(shelley_era.protocol, node["hot_key"],
                                       node["can_be_leader"], lead, hdr)
-            blk = ProtocolBlock(hdr, ())
+            blk = ProtocolBlock(hdr, tuple(body))
         state = rules.tick_then_reapply(state, blk)
         append(blk)
         prev = blk.header
