@@ -25,10 +25,14 @@ per header, N witness sigs per body) is emitted via `extract_proofs` so a
 window of headers/blocks becomes ONE batched device call
 (consensus/batch.py).
 
-Deliberate simplifications vs the real Shelley ledger (documented, not
-accidental): no rewards/treasury accounting, no pool retirement queue, the
-epoch-boundary nonce mix omits the previous-epoch last-header hash, and
-stake snapshots rotate mark->set (2-deep) rather than mark->set->go.
+Ledger depth (the former round-2 simplifications, since implemented):
+mark->set->go 3-deep stake snapshots (SNAP); reserves/treasury monetary
+expansion with per-pool rewards by go-snapshot stake share x apparent
+performance, claimed through exact-balance withdrawals (RUPD/WDRL); a
+pool-retirement queue processed at epoch boundaries (POOLREAP); and the
+full TICKN nonce rule mixing the previous epoch's last header hash into
+the active nonce.  The independent spec oracle in testing/dual.py
+recomputes all four.
 """
 from __future__ import annotations
 
@@ -115,6 +119,10 @@ class TPraosConfig:
     slots_per_kes_period: int = 10
     kes_depth: int = 6                 # Sum6KES -> 64 periods
     max_kes_evolutions: int = 62
+    # monetary expansion / treasury cut (the rho and tau protocol
+    # parameters of the reward calculation)
+    rho: Fraction = Fraction(1, 10)
+    tau: Fraction = Fraction(1, 5)
 
     @property
     def stability_window(self) -> int:
@@ -154,9 +162,13 @@ class TPraosLedgerView:
 class TPraosState:
     """PrtclState + TICKN analog: epoch nonces and per-pool ocert counters.
 
-    eta0  — active nonce: seeds both VRF inputs all epoch
-    eta_v — evolving nonce: folds in every block nonce
-    eta_c — candidate: trails eta_v until the stability window, then frozen
+    eta0   — active nonce: seeds both VRF inputs all epoch
+    eta_v  — evolving nonce: folds in every block nonce
+    eta_c  — candidate: trails eta_v until the stability window, then frozen
+    eta_ph — previous-header nonce: hash of the last applied header (the
+             PRTCL "lab"); at the epoch boundary it is the hash of the
+             previous epoch's final header, mixed into eta0 (the full
+             TICKN rule the reference applies)
     counters — ((pool_id, issue_no), ...) sorted
     """
     epoch: int
@@ -164,6 +176,7 @@ class TPraosState:
     eta_v: bytes
     eta_c: bytes
     counters: tuple = ()
+    eta_ph: bytes = b"\x00" * 32
 
     @classmethod
     def genesis(cls, seed: bytes = b"shelley-genesis") -> "TPraosState":
@@ -255,13 +268,14 @@ class TPraos(ConsensusProtocol):
 
     def tick_chain_dep_state(self, state: TPraosState, ledger_view,
                              slot: int) -> TPraosState:
-        """Cross epoch boundaries (TICKN): the candidate becomes the active
-        nonce.  (The reference also mixes in the previous epoch's last
-        header hash; omitted — see module docstring.)"""
+        """Cross epoch boundaries (TICKN): the candidate nonce combines
+        with the previous epoch's last header hash (eta_ph) to become the
+        active nonce — the full rule (candidate ⭒ prev-hash nonce)."""
         target = self.epoch_of(slot)
         while state.epoch < target:
             nxt = state.epoch + 1
-            eta0 = _b2b(b"tickn:" + state.eta_c + nxt.to_bytes(8, "big"))
+            eta0 = _b2b(b"tickn:" + state.eta_c + state.eta_ph
+                        + nxt.to_bytes(8, "big"))
             state = replace(state, epoch=nxt, eta0=eta0)
         return state
 
@@ -376,14 +390,15 @@ class TPraos(ConsensusProtocol):
 
     def reupdate_chain_dep_state(self, ticked: TPraosState, header,
                                  ledger_view) -> TPraosState:
-        """Nonce evolution (UPDN) + ocert counter bookkeeping — the cheap
-        sequential pass."""
+        """Nonce evolution (UPDN) + lab tracking + ocert counter
+        bookkeeping — the cheap sequential pass."""
         issuer_vk, ocert, pi_eta, _, _ = self._decode_header(header)
         block_nonce = _b2b(self._betas.get(pi_eta))
         eta_v = _b2b(ticked.eta_v + block_nonce)
         eta_c = eta_v if header.slot < self._freeze_slot(ticked.epoch) \
             else ticked.eta_c
-        return replace(ticked, eta_v=eta_v, eta_c=eta_c).with_counter(
+        return replace(ticked, eta_v=eta_v, eta_c=eta_c,
+                       eta_ph=_b2b(b"lab:" + header.hash)).with_counter(
             pool_id_of(issuer_vk), ocert.counter)
 
     # -- leadership ----------------------------------------------------------
@@ -453,8 +468,11 @@ def forge_tpraos_fields(protocol: TPraos, hot_key: HotKey,
 # certificates carried in tx bodies (CBOR-friendly tuples):
 #   ("pool",  cold_vk, vrf_vk)  — register/update a stake pool
 #   ("deleg", addr, pool_id)    — delegate addr's stake to a pool
+#   ("retire", cold_vk, epoch8) — schedule the pool's retirement at the
+#                                 named epoch (POOLREAP; epoch as 8 bytes BE)
 CERT_POOL = "pool"
 CERT_DELEG = "deleg"
+CERT_RETIRE = "retire"
 
 
 @dataclass(frozen=True)
@@ -467,6 +485,9 @@ class ShelleyTx:
       — Allegra+ (timelock validity intervals)
     - mint: ((asset_id, qty), ...), qty<0 burns — Mary+ (multi-asset);
       outputs are (addr, amount[, assets]) with assets ((asset_id, qty),...)
+    - withdrawals: ((pool_id, amount), ...) — claim a reward balance into
+      the tx's spendable value (must match the balance exactly, as in the
+      reference's WDRL rule; witnessed by the pool's cold key)
     """
     inputs: tuple                      # TxIn-like (txid, ix) pairs
     outputs: tuple                     # (addr, amount, assets) triples
@@ -474,6 +495,7 @@ class ShelleyTx:
     witnesses: tuple = ()              # (vk, sig) pairs
     validity: tuple = ()
     mint: tuple = ()
+    withdrawals: tuple = ()            # ((pool_id, amount), ...)
 
     _cache: dict = field(default_factory=dict, repr=False, hash=False,
                          compare=False)
@@ -484,7 +506,8 @@ class ShelleyTx:
                  for a, m, assets in self.outputs],
                 [list(c) for c in self.certs],
                 list(self.validity),
-                [list(mv) for mv in self.mint]]
+                [list(mv) for mv in self.mint],
+                [list(w) for w in self.withdrawals]]
 
     @property
     def txid(self) -> bytes:
@@ -507,9 +530,10 @@ class ShelleyTx:
             tuple((bytes(t), int(i)) for t, i in obj[0]),
             outputs,
             tuple((str(c[0]), bytes(c[1]), bytes(c[2])) for c in obj[2]),
-            tuple((bytes(vk), bytes(sig)) for vk, sig in obj[5]),
+            tuple((bytes(vk), bytes(sig)) for vk, sig in obj[6]),
             tuple(int(v) for v in obj[3]),
-            tuple((bytes(a), int(q)) for a, q in obj[4]))
+            tuple((bytes(a), int(q)) for a, q in obj[4]),
+            tuple((bytes(p), int(q)) for p, q in obj[5]))
 
 
 def _norm_output(o) -> tuple:
@@ -521,12 +545,14 @@ def _norm_output(o) -> tuple:
 
 def make_shelley_tx(inputs: Sequence, outputs: Sequence, certs: Sequence,
                     signing_keys: Sequence[bytes], validity: tuple = (),
-                    mint: Sequence = ()) -> ShelleyTx:
+                    mint: Sequence = (),
+                    withdrawals: Sequence = ()) -> ShelleyTx:
     tx = ShelleyTx(tuple(tuple(i) for i in inputs),
                    tuple(_norm_output(o) for o in outputs),
                    tuple(tuple(c) for c in certs),
                    validity=tuple(validity),
-                   mint=tuple(sorted(tuple(mv) for mv in mint)))
+                   mint=tuple(sorted(tuple(mv) for mv in mint)),
+                   withdrawals=tuple(sorted(tuple(w) for w in withdrawals)))
     wits = tuple((ed25519_ref.public_key(sk), ed25519_ref.sign(sk, tx.txid))
                  for sk in signing_keys)
     return replace(tx, witnesses=wits)
@@ -534,7 +560,10 @@ def make_shelley_tx(inputs: Sequence, outputs: Sequence, certs: Sequence,
 
 @dataclass(frozen=True)
 class ShelleyLedgerState:
-    """UTxO + delegation map + registered pools + 2-deep stake snapshots."""
+    """UTxO + delegation map + registered pools + mark/set/go stake
+    snapshots + the accounting pots (reserves/treasury/rewards) + the
+    pool-retirement queue — the NEWEPOCH state surface of
+    Shelley/Ledger/Ledger.hs:238-284's `applyBlock` rules."""
     utxo: Any                # UtxoMap: (txid, ix) -> (addr, amount, assets)
     delegs: tuple                      # sorted ((addr, pool_id), ...)
     pools: tuple                       # sorted ((pool_id, vrf_vk), ...)
@@ -543,6 +572,12 @@ class ShelleyLedgerState:
     snap_set: tuple                    # snapshot used for leader election
     slot: int
     tip: Point
+    snap_go: tuple = ()                # snapshot rewards are computed from
+    reserves: int = 0                  # undistributed coin (shrinks by rho)
+    treasury: int = 0
+    rewards: tuple = ()                # sorted ((pool_id, claimable), ...)
+    retiring: tuple = ()               # sorted ((pool_id, epoch), ...)
+    blocks_made: tuple = ()            # sorted ((pool_id, n)) this epoch
 
     def __post_init__(self):
         if not isinstance(self.utxo, UtxoMap):
@@ -553,6 +588,12 @@ class ShelleyLedgerState:
     def utxo_dict(self) -> dict:
         return self.utxo.to_dict()
 
+    def reward_of(self, pid: bytes) -> int:
+        for p, amt in self.rewards:
+            if p == pid:
+                return amt
+        return 0
+
     def state_hash(self) -> bytes:
         enc = cbor.dumps([
             [[t, i, a, m, [list(av) for av in assets]]
@@ -562,7 +603,12 @@ class ShelleyLedgerState:
             self.epoch,
             [[p, s, v] for p, s, v in self.snap_mark],
             [[p, s, v] for p, s, v in self.snap_set],
-            self.slot, self.tip.encode()])
+            self.slot, self.tip.encode(),
+            [[p, s, v] for p, s, v in self.snap_go],
+            self.reserves, self.treasury,
+            [[p, a] for p, a in self.rewards],
+            [[p, e] for p, e in self.retiring],
+            [[p, n] for p, n in self.blocks_made]])
         return _b2b(enc)
 
 
@@ -669,9 +715,10 @@ class ShelleyLedger(LedgerRules):
     Allegra, multi-asset values + minting from Mary.
 
     Stake distribution: at every epoch boundary the snapshots rotate
-    set <- mark <- live; leader election (ledger_view) reads `set`, so a
-    delegation change needs two boundaries to affect leadership — the
-    mark/set/go pipeline of the reference, one stage shorter.
+    go <- set <- mark <- live (SNAP); leader election (ledger_view) reads
+    `set`, so a delegation change needs two boundaries to affect
+    leadership, and rewards are computed from `go` — the full
+    mark/set/go pipeline of the reference.
     """
 
     GENESIS_TXID = b"\x00" * 32
@@ -679,9 +726,11 @@ class ShelleyLedger(LedgerRules):
     def __init__(self, genesis: dict, config: TPraosConfig,
                  initial_pools: Optional[dict] = None,
                  initial_delegs: Optional[dict] = None,
-                 era: str = "shelley"):
+                 era: str = "shelley",
+                 initial_reserves: int = 1_000_000):
         """genesis: {addr: amount}; initial_pools: {pool_id: vrf_vk};
-        initial_delegs: {addr: pool_id}."""
+        initial_delegs: {addr: pool_id}; initial_reserves seeds the
+        monetary-expansion pot the reward calculation draws from."""
         if era not in SHELLEY_FAMILY:
             raise ValueError(f"unknown Shelley-family era {era!r}")
         self.genesis = dict(genesis)
@@ -690,13 +739,15 @@ class ShelleyLedger(LedgerRules):
         self.initial_delegs = dict(initial_delegs or {})
         self.era = era
         self._era_ix = SHELLEY_FAMILY.index(era)
+        self.initial_reserves = initial_reserves
 
     def with_era(self, era: str) -> "ShelleyLedger":
         """Same genesis/config under a later era's feature gates — how the
         HFC composes Allegra/Mary over the shared Shelley machinery (the
         reference's ShelleyBasedEra reuse, CanHardFork.hs:365-422)."""
         return ShelleyLedger(self.genesis, self.config, self.initial_pools,
-                             self.initial_delegs, era=era)
+                             self.initial_delegs, era=era,
+                             initial_reserves=self.initial_reserves)
 
     @property
     def supports_validity(self) -> bool:
@@ -716,7 +767,8 @@ class ShelleyLedger(LedgerRules):
         pools = tuple(sorted(self.initial_pools.items()))
         snap = self._stake_distr(utxo_f, delegs, pools)
         return ShelleyLedgerState(utxo_f, delegs, pools, 0, snap, snap,
-                                  -1, Point.genesis())
+                                  -1, Point.genesis(), snap_go=snap,
+                                  reserves=self.initial_reserves)
 
     @staticmethod
     def _stake_distr(utxo: "UtxoMap", delegs: tuple, pools: tuple) -> tuple:
@@ -736,13 +788,63 @@ class ShelleyLedger(LedgerRules):
     def tip(self, state: ShelleyLedgerState) -> Point:
         return state.tip
 
-    # -- ticking (epoch snapshot rotation) -----------------------------------
+    # -- ticking (epoch boundary: rewards, rotation, retirement) -------------
+    def _epoch_rewards(self, state: ShelleyLedgerState
+                       ) -> tuple[int, int, tuple]:
+        """One epoch's reward calculation (the RUPD/NEWEPOCH pulse):
+        rho of the reserves becomes the pot, tau of the pot goes to the
+        treasury, the rest is split over the GO snapshot's pools by stake
+        share scaled by apparent performance (blocks made / expected);
+        the undistributed remainder returns to the reserves.  All integer
+        arithmetic — every node computes the identical result."""
+        cfg = self.config
+        pot = state.reserves * cfg.rho.numerator // cfg.rho.denominator
+        if pot == 0:
+            return state.reserves, state.treasury, state.rewards
+        to_treasury = pot * cfg.tau.numerator // cfg.tau.denominator
+        distributable = pot - to_treasury
+        total_go = sum(s for _p, s, _v in state.snap_go)
+        made = dict(state.blocks_made)
+        total_blocks = sum(made.values())
+        rewards = dict(state.rewards)
+        paid = 0
+        for pid, stake, _vrf in state.snap_go:
+            if total_go == 0 or total_blocks == 0:
+                break
+            base = distributable * stake // total_go
+            expected = max(1, total_blocks * stake // total_go)
+            r = base * min(made.get(pid, 0), expected) // expected
+            if r:
+                rewards[pid] = rewards.get(pid, 0) + r
+                paid += r
+        reserves = state.reserves - to_treasury - paid
+        return reserves, state.treasury + to_treasury, \
+            tuple(sorted(rewards.items()))
+
     def tick(self, state: ShelleyLedgerState, slot: int) -> ShelleyLedgerState:
         target = slot // self.config.epoch_length
         while state.epoch < target:
+            nxt = state.epoch + 1
+            # 1. rewards from the (pre-rotation) GO snapshot and the
+            #    blocks made in the ending epoch
+            reserves, treasury, rewards = self._epoch_rewards(state)
+            # 2. snapshot rotation go <- set <- mark <- live (SNAP)
             live = self._stake_distr(state.utxo, state.delegs, state.pools)
-            state = replace(state, epoch=state.epoch + 1,
-                            snap_set=state.snap_mark, snap_mark=live)
+            # 3. pool retirement (POOLREAP): pools due at the new epoch
+            #    leave the registry; their delegations lapse; accrued
+            #    rewards stay claimable
+            due = {p for p, e in state.retiring if e <= nxt}
+            pools = tuple((p, v) for p, v in state.pools if p not in due)
+            delegs = (tuple((a, p) for a, p in state.delegs
+                            if p not in due) if due else state.delegs)
+            state = replace(
+                state, epoch=nxt, snap_go=state.snap_set,
+                snap_set=state.snap_mark, snap_mark=live,
+                pools=pools, delegs=delegs,
+                retiring=tuple((p, e) for p, e in state.retiring
+                               if p not in due),
+                reserves=reserves, treasury=treasury, rewards=rewards,
+                blocks_made=())
         return replace(state, slot=slot)
 
     # -- protocol support ----------------------------------------------------
@@ -795,6 +897,7 @@ class ShelleyLedger(LedgerRules):
                    block) -> ShelleyLedgerState:
         utxo = state.utxo
         delegs = pools = None          # copied lazily: certs are rare
+        rewards = retiring = None      # likewise
         for tx in block.body:
             self._check_features(tx, block.slot)
             if len(set(tx.inputs)) != len(tx.inputs):
@@ -811,6 +914,17 @@ class ShelleyLedger(LedgerRules):
                 spent += amount
                 for aid, qty in assets:
                     consumed_assets[aid] = consumed_assets.get(aid, 0) + qty
+            for pid, amount in tx.withdrawals:
+                if rewards is None:
+                    rewards = dict(state.rewards)
+                bal = rewards.get(pid, 0)
+                # WDRL: the claim must match the reward balance exactly
+                if amount <= 0 or amount != bal:
+                    raise LedgerError(
+                        f"tx {tx.txid.hex()[:12]}: withdrawal {amount} != "
+                        f"reward balance {bal} of {pid.hex()[:12]}")
+                del rewards[pid]
+                spent += amount
             for aid, qty in tx.mint:
                 consumed_assets[aid] = consumed_assets.get(aid, 0) + qty
             produced = 0
@@ -841,25 +955,60 @@ class ShelleyLedger(LedgerRules):
                     delegs = dict(state.delegs)
                     pools = dict(state.pools)
                 if kind == CERT_POOL:
-                    pools[pool_id_of(a)] = b
+                    pid = pool_id_of(a)
+                    pools[pid] = b
+                    if retiring is None:
+                        retiring = dict(state.retiring)
+                    # re-registration cancels a pending retirement
+                    retiring.pop(pid, None)
                 elif kind == CERT_DELEG:
                     if b not in pools:
                         raise LedgerError(
                             f"delegation to unregistered pool "
                             f"{b.hex()[:12]}")
                     delegs[a] = b
+                elif kind == CERT_RETIRE:
+                    pid = pool_id_of(a)
+                    if pid not in pools:
+                        raise LedgerError(
+                            f"retirement of unregistered pool "
+                            f"{pid.hex()[:12]}")
+                    epoch = int.from_bytes(b, "big")
+                    if epoch <= state.epoch:
+                        raise LedgerError(
+                            f"retirement epoch {epoch} not after the "
+                            f"current epoch {state.epoch}")
+                    if retiring is None:
+                        retiring = dict(state.retiring)
+                    retiring[pid] = epoch
                 else:
                     raise LedgerError(f"unknown certificate kind {kind!r}")
             utxo = utxo.apply(
                 tx.inputs,
                 [((tx.txid, ix), (addr, amount, assets))
                  for ix, (addr, amount, assets) in enumerate(tx.outputs)])
+        # block production accounting for the reward calculation (the
+        # BlocksMade map); the mempool's header-less pseudo-blocks skip it
+        blocks_made = state.blocks_made
+        header = getattr(block, "header", None)
+        issuer_vk = header.get(ISSUER_FIELD) if header is not None \
+            and hasattr(header, "get") else None
+        if issuer_vk is not None:
+            made = dict(blocks_made)
+            pid = pool_id_of(issuer_vk)
+            made[pid] = made.get(pid, 0) + 1
+            blocks_made = tuple(sorted(made.items()))
         return replace(
             state, utxo=utxo,
             delegs=state.delegs if delegs is None
             else tuple(sorted(delegs.items())),
             pools=state.pools if pools is None
             else tuple(sorted(pools.items())),
+            rewards=state.rewards if rewards is None
+            else tuple(sorted(rewards.items())),
+            retiring=state.retiring if retiring is None
+            else tuple(sorted(retiring.items())),
+            blocks_made=blocks_made,
             tip=point_of(block))
 
     def check_tx_witnesses(self, state: ShelleyLedgerState,
@@ -882,6 +1031,16 @@ class ShelleyLedger(LedgerRules):
             if kind == CERT_DELEG and a not in wit_vks:
                 raise LedgerError(
                     "delegation without the staking-key witness")
+            if kind == CERT_RETIRE and a not in wit_vks:
+                raise LedgerError(
+                    "pool retirement without the cold-key witness")
+        # withdrawals: the pool's cold key must witness the claim
+        wit_pids = {pool_id_of(vk) for vk in wit_vks}
+        for pid, _amt in tx.withdrawals:
+            if pid not in wit_pids:
+                raise LedgerError(
+                    f"withdrawal from {pid.hex()[:12]} without the pool "
+                    f"cold-key witness")
         # minting: asset_id is the key-hash of the policy key, which must
         # witness the tx (the Mary "policy script = key" base case)
         policy_hashes = {pool_id_of(vk) for vk in wit_vks}

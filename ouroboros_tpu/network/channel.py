@@ -39,11 +39,6 @@ class Channel:
         which can lose state in the cancelled continuation)."""
         return await sim.wait_pred(lambda tx: self._in.size(tx) > 0, timeout)
 
-    async def try_recv(self):
-        """One queued item without blocking, or None when empty — the
-        non-blocking half of the wait_ready/try_recv pair (CodecChannel
-        drains its byte channel through exactly this interface)."""
-        return await sim.atomically(self._in.try_get)
 
 
 def channel_pair(capacity: int = 64, delay: float = 0.0,

@@ -18,7 +18,9 @@ from typing import Any, Optional
 
 from ..consensus.ledger import LedgerError, LedgerRules
 from ..eras.byron import CERT_DLG, CERT_UPDATE
-from ..eras.shelley import CERT_DELEG, CERT_POOL, pool_id_of
+from ..eras.shelley import (
+    CERT_DELEG, CERT_POOL, CERT_RETIRE, ISSUER_FIELD, pool_id_of,
+)
 
 
 class DualLedgerMismatch(AssertionError):
@@ -103,7 +105,8 @@ class ShelleySpec:
     scratch (vs the impl's incremental mark/set snapshot rotation)."""
 
     def __init__(self, genesis: dict, config, initial_pools,
-                 initial_delegs, era: str = "shelley"):
+                 initial_delegs, era: str = "shelley",
+                 initial_reserves: int = 1_000_000):
         self.utxo = {(b"\x00" * 32, ix): (addr, amt, ())
                      for ix, (addr, amt) in enumerate(
                          sorted(genesis.items()))}
@@ -115,6 +118,12 @@ class ShelleySpec:
         # snapshots as plain recomputations
         self.snap_mark = self._stake()
         self.snap_set = dict(self.snap_mark)
+        self.snap_go = dict(self.snap_mark)
+        self.reserves = initial_reserves
+        self.treasury = 0
+        self.rewards: dict = {}
+        self.retiring: dict = {}
+        self.blocks_made: dict = {}
 
     def _stake(self) -> dict:
         by_addr: dict = {}
@@ -126,12 +135,50 @@ class ShelleySpec:
                 out[pid] = out.get(pid, 0) + by_addr.get(addr, 0)
         return {p: s for p, s in out.items() if s > 0}
 
+    def note_block(self, issuer_vk) -> None:
+        if issuer_vk is not None:
+            pid = pool_id_of(issuer_vk)
+            self.blocks_made[pid] = self.blocks_made.get(pid, 0) + 1
+
     def tick_to(self, slot: int) -> None:
-        target = slot // self.config.epoch_length
+        cfg = self.config
+        target = slot // cfg.epoch_length
         while self.epoch < target:
             self.epoch += 1
+            # rewards: rho of reserves -> pot, tau of pot -> treasury,
+            # rest split over the GO snapshot by stake x performance
+            pot = self.reserves * cfg.rho.numerator // cfg.rho.denominator
+            if pot:
+                cut = pot * cfg.tau.numerator // cfg.tau.denominator
+                distributable = pot - cut
+                total_go = sum(self.snap_go.values())
+                total_blocks = sum(self.blocks_made.values())
+                paid = 0
+                if total_go and total_blocks:
+                    for pid in sorted(self.snap_go):
+                        stake = self.snap_go[pid]
+                        base = distributable * stake // total_go
+                        expected = max(1, total_blocks * stake // total_go)
+                        r = base * min(self.blocks_made.get(pid, 0),
+                                       expected) // expected
+                        if r:
+                            self.rewards[pid] = self.rewards.get(pid, 0) + r
+                            paid += r
+                self.reserves -= cut + paid
+                self.treasury += cut
+            # rotation go <- set <- mark <- live
+            self.snap_go = dict(self.snap_set)
             self.snap_set = dict(self.snap_mark)
             self.snap_mark = self._stake()
+            # retirement
+            due = {p for p, e in self.retiring.items() if e <= self.epoch}
+            for p in due:
+                self.pools.pop(p, None)
+                self.retiring.pop(p, None)
+            if due:
+                self.delegs = {a: p for a, p in self.delegs.items()
+                               if p not in due}
+            self.blocks_made = {}
 
     def apply_tx(self, tx, slot: int) -> None:
         # feature gating (era-indexed tx admission)
@@ -153,12 +200,19 @@ class ShelleySpec:
             if key in self.utxo and self.utxo[key][0] not in wit_vks:
                 raise LedgerError("spec: spend without witness")
         for kind, a, _b in tx.certs:
-            if kind in (CERT_POOL, CERT_DELEG) and a not in wit_vks:
+            if kind in (CERT_POOL, CERT_DELEG, CERT_RETIRE) \
+                    and a not in wit_vks:
                 raise LedgerError("spec: unwitnessed certificate")
         policies = {pool_id_of(vk) for vk in wit_vks}
         for aid, _q in tx.mint:
             if aid not in policies:
                 raise LedgerError("spec: unwitnessed mint policy")
+        wds = getattr(tx, "withdrawals", ())
+        if len({p for p, _a in wds}) != len(wds):
+            raise LedgerError("spec: duplicate withdrawals")
+        for pid, _amt in wds:
+            if pid not in policies:
+                raise LedgerError("spec: unwitnessed withdrawal")
         if len(set(tx.inputs)) != len(tx.inputs):
             raise LedgerError("spec: duplicate inputs")
         spent = 0
@@ -170,6 +224,10 @@ class ShelleySpec:
             spent += amt
             for aid, q in assets:
                 consumed[aid] = consumed.get(aid, 0) + q
+        for pid, amt in getattr(tx, "withdrawals", ()):
+            if amt <= 0 or amt != self.rewards.get(pid, 0):
+                raise LedgerError("spec: withdrawal != reward balance")
+            spent += amt
         for aid, q in tx.mint:
             consumed[aid] = consumed.get(aid, 0) + q
         produced = 0
@@ -189,12 +247,23 @@ class ShelleySpec:
         for kind, a, b in tx.certs:
             if kind == CERT_POOL:
                 self.pools[pool_id_of(a)] = b
+                self.retiring.pop(pool_id_of(a), None)
             elif kind == CERT_DELEG:
                 if b not in self.pools:
                     raise LedgerError("spec: unregistered pool")
                 self.delegs[a] = b
+            elif kind == CERT_RETIRE:
+                pid = pool_id_of(a)
+                if pid not in self.pools:
+                    raise LedgerError("spec: retiring unregistered pool")
+                epoch = int.from_bytes(b, "big")
+                if epoch <= self.epoch:
+                    raise LedgerError("spec: retirement not in the future")
+                self.retiring[pid] = epoch
             else:
                 raise LedgerError("spec: unknown cert")
+        for pid, _amt in getattr(tx, "withdrawals", ()):
+            del self.rewards[pid]
         for key in tx.inputs:
             del self.utxo[key]
         for ix, (addr, amt, assets) in enumerate(tx.outputs):
@@ -204,7 +273,12 @@ class ShelleySpec:
         return {"utxo": dict(self.utxo), "pools": dict(self.pools),
                 "delegs": dict(self.delegs), "epoch": self.epoch,
                 "snap_set": dict(self.snap_set),
-                "snap_mark": dict(self.snap_mark)}
+                "snap_mark": dict(self.snap_mark),
+                "snap_go": dict(self.snap_go),
+                "reserves": self.reserves, "treasury": self.treasury,
+                "rewards": dict(self.rewards),
+                "retiring": dict(self.retiring),
+                "blocks_made": dict(self.blocks_made)}
 
 
 # ---------------------------------------------------------------------------
@@ -224,7 +298,12 @@ def _observe_shelley_impl(state) -> dict:
             "delegs": dict(state.delegs),
             "epoch": state.epoch,
             "snap_set": {p: s for p, s, _v in state.snap_set},
-            "snap_mark": {p: s for p, s, _v in state.snap_mark}}
+            "snap_mark": {p: s for p, s, _v in state.snap_mark},
+            "snap_go": {p: s for p, s, _v in state.snap_go},
+            "reserves": state.reserves, "treasury": state.treasury,
+            "rewards": dict(state.rewards),
+            "retiring": dict(state.retiring),
+            "blocks_made": dict(state.blocks_made)}
 
 
 @dataclass
@@ -274,6 +353,11 @@ class DualLedger:
                 spec_try.tick_to(block.slot)
                 for tx in block.body:
                     spec_try.apply_tx(tx, block.slot)
+                # block-production accounting (BlocksMade), mirroring the
+                # impl's header-issuer bookkeeping
+                header = getattr(block, "header", None)
+                if header is not None and hasattr(header, "get"):
+                    spec_try.note_block(header.get(ISSUER_FIELD))
             except LedgerError as e:
                 spec_err = e
         else:
@@ -301,11 +385,11 @@ def dual_byron(genesis: dict, genesis_vks, initial_delegates):
 
 
 def dual_shelley(genesis: dict, config, initial_pools, initial_delegs,
-                 era: str = "shelley"):
+                 era: str = "shelley", initial_reserves: int = 1_000_000):
     from ..eras.shelley import ShelleyLedger
     impl = ShelleyLedger(genesis, config, initial_pools, initial_delegs,
-                         era=era)
+                         era=era, initial_reserves=initial_reserves)
     spec = ShelleySpec(genesis, config, initial_pools, initial_delegs,
-                       era=era)
+                       era=era, initial_reserves=initial_reserves)
     return DualLedger(impl, impl.initial_state(), spec,
                       _observe_shelley_impl, era="shelley")

@@ -150,11 +150,11 @@ EXPECTED = {
     "localtxsubmission": "2f7ef01c240b2671ab4043d2a0812d747538f26237d4fae48e875c0dbd292e34",
     "localtxmonitor": "e71b38f3e981217c9bda46ba8e8adb38ce9604a2a31e9c7ce86b14c1a8081d1a",
     "tipsample": "da67183f7d2501fc3c13a500e7f34409e97264f9ab36529d5c2c3dffd5d7a700",
-    "shelley_tx": "26ff9a02a82c59d0d1b9911d4bf347e9e952c43ced2bf1f8800b8a99c71f1f1c",
-    "mary_tx": "7054a51938dd284ce677aace157160db80f0c168471974d5ea862ab57086aee0",
+    "shelley_tx": "10840410cfbeb6b63c8fc9edf40f5b70683768428ee98c6f1cec528df63ce918",
+    "mary_tx": "4d03b31be3370a2d4599e1d3de392be78d0ad578c821c3cd504f36456932f52b",
     "byron_tx": "93a6e559799eaa7d4fe22efb70e72048fc53b2f4c666a00dec67bd50dd10025f",
     "mock_tx": "711d5d0203ff4ebf55b092627e8e293ca9d4bedd9968661c76275d8320aa11f5",
-    "protocol_block": "410280a5a1fbb71f4741daeff8f6f6b5f454a26c8951ad88d713e584a81561e5",
+    "protocol_block": "dd0569b97051d06d5b3c1da851d56d4a6634fc8d73cb32761a28edc8acc86e8b",
 }
 
 

@@ -67,7 +67,10 @@ def test_gamma8_pallas_interpret_matches_proof_to_hash():
     from ouroboros_tpu.crypto import vrf_jax
     sk = hashlib.sha256(b"pallas-g8").digest()
     proofs = [vrf_ref.prove(sk, b"g%d" % i) for i in range(7)]
-    proofs.append(b"\x00" * 80)             # undecodable
+    # undecodable: Gamma y >= p and s >= L (note the all-ZEROS proof IS
+    # decodable — y=0 is the curve point (sqrt(-1), 0))
+    proofs.append(b"\xff" * 80)
+    assert vrf_ref.decode_proof(proofs[7]) is None
     handle, decode_ok = vrf_jax._submit_betas(
         proofs, 8, runner=PK.gamma8_pallas)
     betas = vrf_jax._finish_betas(np.asarray(handle), decode_ok, 8)
