@@ -32,7 +32,8 @@ performance, claimed through exact-balance withdrawals (RUPD/WDRL); a
 pool-retirement queue processed at epoch boundaries (POOLREAP); and the
 full TICKN nonce rule mixing the previous epoch's last header hash into
 the active nonce.  The independent spec oracle in testing/dual.py
-recomputes all four.
+recomputes the three ledger rules; the nonce rule is covered by direct
+unit tests (tests/test_shelley_depth.py TestFullNonceRule).
 """
 from __future__ import annotations
 

@@ -49,6 +49,25 @@ class FetchBudget:
     max_concurrent_peers: int = 4
     max_request_expected_secs: float = 5.0
 
+    @classmethod
+    def bulk_sync(cls) -> "FetchBudget":
+        """FetchModeBulkSync: far from the tip — few peers, big batches
+        (maximise throughput; losing a duplicate-fetch race is cheap)."""
+        return cls(max_blocks_per_request=32,
+                   max_in_flight_bytes_per_peer=512 * 1024,
+                   max_concurrent_peers=2,
+                   max_request_expected_secs=20.0)
+
+    @classmethod
+    def deadline(cls) -> "FetchBudget":
+        """FetchModeDeadline: near the tip — more peers, small requests,
+        tight expected-duration bound (minimise time-to-adoption; the
+        block-diffusion deadline of BASELINE.md)."""
+        return cls(max_blocks_per_request=4,
+                   max_in_flight_bytes_per_peer=128 * 1024,
+                   max_concurrent_peers=8,
+                   max_request_expected_secs=2.0)
+
 
 class PeerFetchState:
     """Per-peer fetch bookkeeping (ClientState.hs `PeerFetchStatus` +
@@ -144,11 +163,22 @@ def fetch_decisions(
         cap = min(budget.max_blocks_per_request, max(1, bytes_left // est))
         tracker = gsv(peer) if gsv is not None else None
         if tracker is not None:
-            n = 1
-            while n < cap and tracker.expected_fetch_time(
-                    (n + 1) * est) <= budget.max_request_expected_secs:
-                n += 1
-            cap = n
+            if tracker.expected_fetch_time(est) \
+                    > budget.max_request_expected_secs:
+                if decisions:
+                    # a faster peer is already fetching this round: the
+                    # slow peer loses the race entirely (Decision.hs
+                    # deadline-mode peer filtering)
+                    continue
+                # sole source: fetch slowly (one block) rather than
+                # starve — a too-slow ONLY peer must still make progress
+                cap = 1
+            else:
+                n = 1
+                while n < cap and tracker.expected_fetch_time(
+                        (n + 1) * est) <= budget.max_request_expected_secs:
+                    n += 1
+                cap = n
         # resume the scan at the stored frontier when it is still on the
         # fragment (a rollback may have invalidated it — then rescan)
         blocks = None
@@ -215,19 +245,34 @@ async def fetch_logic_loop(kernel) -> None:
     """The blockFetchLogic iteration thread (BlockFetch.hs:239): re-runs
     the decision pipeline whenever a candidate, the current chain, or the
     in-flight set changes, and enqueues requests to per-peer clients."""
+    from ..utils.tracer import TraceFetchDecision
     while True:
         seen = kernel.fetch_wakeup.value
+        # fetch MODE (BlockFetchConsensusInterface readFetchMode): far
+        # behind the best candidate -> bulk sync; near the tip -> deadline
+        our_bn = kernel.chain_db.current_chain.head_block_no
+        best_bn = max(
+            (c.fragment.head_block_no for c in kernel.candidates.values()
+             if c.fragment is not None and len(c.fragment)),
+            default=our_bn)
+        budget = (FetchBudget.bulk_sync() if best_bn - our_bn > 16
+                  else FetchBudget.deadline())
         decisions = fetch_decisions(
             {p: c.fragment for p, c in kernel.candidates.items()},
             kernel.peer_fetch,
             kernel.plausible_candidate,
             kernel.have_block,
             order_key=kernel.fetch_order_key,
+            budget=budget,
             gsv=kernel.peer_gsv.get)
         for req in decisions:
             ps = kernel.peer_fetch[req.peer_id]
             ps.in_flight |= {h.hash for h in req.headers}
             ps.in_flight_bytes += req.est_bytes
+            if kernel.tracers.fetch.active:
+                kernel.tracers.fetch.trace(TraceFetchDecision(
+                    peer_id=req.peer_id, n_requested=len(req.headers),
+                    in_flight_bytes=ps.in_flight_bytes, reason="request"))
 
             def push(tx, ps=ps, req=req):
                 ps.queue.put(tx, req)

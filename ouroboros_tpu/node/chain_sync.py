@@ -34,6 +34,17 @@ from ..simharness import Retry, TVar
 _OFFSETS = (0, 1, 2, 3, 5, 8, 13, 21, 34, 55, 89, 144)
 
 
+def pipeline_decision(outstanding: int, low: int, high: int,
+                      caught_up: bool) -> str:
+    """The low/high-watermark pipelining policy
+    (Protocol/ChainSync/PipelineDecision.hs pipelineDecisionLowHighMark):
+    behind the server tip, pipeline until the HIGH mark; caught up, only
+    refill to the LOW mark (collect otherwise) so a quiescent tip is not
+    saturated with speculative requests."""
+    target = low if caught_up else high
+    return "pipeline" if outstanding < target else "collect"
+
+
 class ChainSyncClientError(Exception):
     """Peer sent an invalid header / rolled back too deep — disconnect and
     (for invalid headers) remember the block as bad (Client.hs:1114)."""
@@ -119,6 +130,11 @@ async def chain_sync_client(session, kernel, candidate: CandidateState,
             fragment.add_block(h)
         del buffered[:res.n_valid]
         if res.n_valid:
+            if kernel.tracers.chain_sync.active:
+                from ..utils.tracer import TraceChainSyncEvent
+                kernel.tracers.chain_sync.trace(TraceChainSyncEvent(
+                    peer_id=candidate.peer_id, event="validated",
+                    slot=fragment.head_point.slot, n=res.n_valid))
             candidate.publish(fragment.copy())
         if res.error is None:
             return
@@ -130,10 +146,24 @@ async def chain_sync_client(session, kernel, candidate: CandidateState,
                                    f"{res.error}")
 
     horizon_stalled = [False]
+    # watermark pipelining (Protocol/ChainSync/PipelineDecision.hs
+    # low/high mark): while BEHIND the server tip the pipeline fills to
+    # the high mark (`window`); once caught up new requests only refill
+    # to the low mark, so a quiescent tip holds few outstanding requests
+    low_mark = max(1, window // 4)
+    caught_up = [False]
+
+    def _note_tip(tip) -> None:
+        # count the not-yet-validated buffered headers too: a single push
+        # at the tip must not flip the policy back to the high mark
+        caught_up[0] = (tip is not None
+                        and fragment.head_block_no + len(buffered)
+                        >= tip.block_no)
 
     # -- pipelined follow loop ------------------------------------------------
     while True:
-        while session.outstanding < window:
+        while pipeline_decision(session.outstanding, low_mark, window,
+                                caught_up[0]) == "pipeline":
             await session.send_pipelined(MsgRequestNext(), "StIdle")
         if horizon_stalled[0] and buffered:
             # forecast horizon hit: our own chain must advance (BlockFetch
@@ -151,16 +181,19 @@ async def chain_sync_client(session, kernel, candidate: CandidateState,
         if isinstance(msg, MsgAwaitReply):
             # caught up: validate what we have, then wait for the next
             # server push (the collect below blocks on the channel)
+            caught_up[0] = True
             flush()
             continue
         if isinstance(msg, MsgRollForward):
             buffered.append(msg.header)
+            _note_tip(msg.tip)
             if len(buffered) >= window:
                 flush()
             elif session.outstanding == 0:
                 flush()
             continue
         if isinstance(msg, MsgRollBackward):
+            _note_tip(msg.tip)
             flush()
             if not history.rewind(msg.point):
                 raise ChainSyncClientError(

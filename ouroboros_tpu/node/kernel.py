@@ -57,7 +57,9 @@ class NodeKernel:
     def __init__(self, chain_db, ledger_rules, mempool: Optional[Mempool],
                  btime: BlockchainTime, forgings=(), label: str = "node",
                  backend=None, chain_sync_window: int = 32,
-                 header_decode=None, block_decode_obj=None, tx_decode=None):
+                 header_decode=None, block_decode_obj=None, tx_decode=None,
+                 tracers=None):
+        from ..utils.tracer import NodeTracers
         self.chain_db = chain_db
         self.ledger_rules = ledger_rules
         self.protocol = chain_db.ext_rules.protocol
@@ -70,6 +72,8 @@ class NodeKernel:
         self.header_decode = header_decode
         self.block_decode_obj = block_decode_obj
         self.tx_decode = tx_decode
+        # per-subsystem typed tracer bundle (Node/Tracers.hs:51-62)
+        self.tracers = tracers if tracers is not None else NodeTracers.nop()
 
         self.candidates: Dict[object, CandidateState] = {}
         self.peer_fetch: Dict[object, PeerFetchState] = {}
@@ -259,6 +263,10 @@ class NodeKernel:
         block = ProtocolBlock(signed, body)
         res = self.chain_db.add_block(block)
         sim.trace_event(("forged", self.label, slot, res.kind))
+        if self.tracers.forge.active:
+            from ..utils.tracer import TraceForgeEvent
+            self.tracers.forge.trace(TraceForgeEvent(
+                slot=slot, outcome="forged", detail=res.kind))
 
 
 def connect_nodes(a: NodeKernel, b: NodeKernel, delay: float = 0.0,

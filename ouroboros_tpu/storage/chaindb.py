@@ -90,7 +90,9 @@ class ChainDB:
                  block_decode: Callable[[bytes], Any],
                  backend=None, disk_policy: DiskPolicy = DiskPolicy(),
                  fs: Optional[FsApi] = None,
-                 encode_state: Optional[Callable] = None):
+                 encode_state: Optional[Callable] = None, tracer=None):
+        from ..utils.tracer import NOP
+        self.tracer = tracer if tracer is not None else NOP
         self.ext_rules = ext_rules
         self.immutable = immutable
         self.volatile = volatile
@@ -135,7 +137,7 @@ class ChainDB:
              block_decode: Callable[[bytes], Any],
              chunk_size: int = 100, max_blocks_per_file: int = 50,
              backend=None, disk_policy: DiskPolicy = DiskPolicy(),
-             validate_chunks: bool = True) -> "ChainDB":
+             validate_chunks: bool = True, tracer=None) -> "ChainDB":
         immutable = ImmutableDB.open(fs, chunk_size,
                                      validate_all=validate_chunks)
         volatile = VolatileDB.open(fs, max_blocks_per_file)
@@ -168,7 +170,7 @@ class ChainDB:
         ledger_db = LedgerDB(k, anchor, ext_state)
         db = cls(ext_rules, immutable, volatile, ledger_db, block_decode,
                  backend=backend, disk_policy=disk_policy, fs=fs,
-                 encode_state=encode_state)
+                 encode_state=encode_state, tracer=tracer)
         db._initial_chain_selection()
         return db
 
@@ -315,7 +317,13 @@ class ChainDB:
                 return AddBlockResult("from_future", self.tip_point())
         self.volatile.put_block(h, block.prev_hash, block.slot,
                                 block.block_no, block.bytes)
-        return self._chain_selection_for(block)
+        res = self._chain_selection_for(block)
+        if self.tracer.active:
+            from ..utils.tracer import TraceAddBlock
+            self.tracer.trace(TraceAddBlock(
+                kind=res.kind, slot=block.slot, block_no=block.block_no,
+                hash=h))
+        return res
 
     def on_slot_tick(self, slot: int) -> list[AddBlockResult]:
         """Re-triage buffered future blocks whose slot has arrived
@@ -574,6 +582,10 @@ class ChainDB:
             # (ADVICE r2; cf. ChainSync forecast-horizon waiting)
             for b in list(blocks)[res.n_valid:]:
                 self.invalid[b.hash] = str(res.error)
+                if self.tracer.active:
+                    from ..utils.tracer import TraceInvalidBlock
+                    self.tracer.trace(TraceInvalidBlock(
+                        hash=b.hash, reason=str(res.error)))
         if not valid_blocks and n_rollback > 0:
             return False
         # does the valid prefix still beat the current chain?
@@ -596,6 +608,12 @@ class ChainDB:
         if not ok:
             return False
         old_point = self.tip_point()
+        if n_rollback > 0 and self.tracer.active:
+            from ..utils.tracer import TraceSwitchedToFork
+            self.tracer.trace(TraceSwitchedToFork(
+                old_tip_slot=old_point.slot,
+                new_tip_slot=new_chain.head_point.slot,
+                rollback_depth=n_rollback))
         self.current_chain = new_chain
         self._bump()
         for f in self._followers.values():

@@ -81,6 +81,10 @@ class ThreadNetConfig:
     tx_plan: tuple = ()
     # per-node handshake network magic (default: all 0 — one network)
     network_magics: Optional[Sequence[int]] = None
+    # (slot, node_ix) pairs: stop the node at `slot` and restart it from
+    # its own on-disk state (NodeRestarts.hs analog — the restarted node
+    # re-opens its ChainDB, replays, reconnects, and catches up)
+    restart_plan: tuple = ()
 
 
 @dataclass
@@ -191,12 +195,18 @@ class PraosNetworkFactory:
                          tuple(bytes(p) for p in obj[4][2]))
         return ExtLedgerState(led, HeaderState(tip, dep))
 
-    def make_node(self, i: int) -> NodeKernel:
+    def make_node(self, i: int, fs=None,
+                  label: Optional[str] = None) -> NodeKernel:
+        """Build node i's full stack; pass its previous MockFS to model a
+        RESTART — ChainDB.open then recovers from the on-disk state.
+        Restarts must also pass a FRESH label: peer ids derive from it,
+        and reusing the old one would collide the neighbors' per-peer
+        state with the dead connection's."""
         cfg, keys = self.cfg, self.keys
         protocol = Praos(self.protocol_cfg)
         ledger = MockLedger(self.genesis)
         ext_rules = ExtLedgerRules(protocol, ledger)
-        fs = MockFS()
+        fs = fs if fs is not None else MockFS()
         db = ChainDB.open(fs, ext_rules, self.enc_state, self.dec_state,
                           self.block_decode, backend=self.backend)
         mempool = Mempool(ledger,
@@ -211,13 +221,14 @@ class PraosNetworkFactory:
                 praos_forge_fields(protocol, hk, proof, hdr))
         btime = BlockchainTime(cfg.slot_length)
         kern = NodeKernel(db, ledger, mempool, btime, [forging],
-                          label=f"node{i}", backend=self.backend,
+                          label=label or f"node{i}", backend=self.backend,
                           chain_sync_window=cfg.chain_sync_window,
                           header_decode=self.header_decode_obj,
                           block_decode_obj=self.block_decode_obj,
                           tx_decode=Tx.decode)
         if cfg.network_magics is not None:
             kern.network_magic = cfg.network_magics[i]
+        kern.fs = fs                      # restartable: same disk next time
         return kern
 
     def forge_at(self, i: int, slot: int, ext_state) -> ProtocolBlock:
@@ -314,6 +325,30 @@ def run_threadnet(cfg: ThreadNetConfig) -> ThreadNetResult:
                 tx = tx_factory(keys, kern.chain_db.current_ledger.ledger)
                 kern.mempool.try_add_txs([tx])
             sim.spawn(submit(), label=f"tx@{slot}")
+
+        for slot, node_ix in cfg.restart_plan:
+            async def restart(slot=slot, node_ix=node_ix):
+                at = slot * cfg.slot_length
+                if at > sim.now():
+                    await sim.sleep(at - sim.now())
+                old = started[node_ix]
+                old.stop()
+                fs = old.fs
+                await sim.sleep(0.5 * cfg.slot_length)   # downtime
+                # recover from disk, under a FRESH label: peer ids derive
+                # from labels, and reusing the old one would collide the
+                # neighbors' per-peer state with the dead connection's
+                kern = make_node(node_ix, fs=fs,
+                                 label=f"{old.label}r")
+                kernels.append(kern)
+                started[node_ix] = kern
+                kern.start()
+                for a, b in edges():
+                    if node_ix in (a, b) and a in started and b in started:
+                        connect_nodes(started[a], started[b],
+                                      delay=cfg.link_delay
+                                      * cfg.slot_length)
+            sim.spawn(restart(), label=f"restart-{node_ix}@{slot}")
 
         await sim.sleep(cfg.n_slots * cfg.slot_length - sim.now()
                         + 2 * cfg.slot_length)

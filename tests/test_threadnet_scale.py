@@ -1,0 +1,115 @@
+"""ThreadNet at scale + node restarts (NodeRestarts.hs analog) + typed
+tracer assertions.
+
+- the fast partition runs a 4-node network with a mid-run restart: the
+  restarted node recovers from its own on-disk state and catches up
+- the `slow` partition runs BASELINE config #1 (10 nodes / 1k slots
+  mock-Praos, the nightly-budget scale) with restarts, deterministic per
+  seed — `pytest -m slow`
+- tracer test: a two-node sync asserts on TYPED decision events
+  (fetch requests, chainsync validation, forging, ChainDB adds) instead
+  of end-state only (Node/Tracers.hs:51-62 role)
+"""
+import pytest
+
+from ouroboros_tpu.testing import ThreadNetConfig, run_threadnet
+
+
+def _run(cfg):
+    res = run_threadnet(cfg)
+    assert not res.failures, res.failures
+    return res
+
+
+class TestRestarts:
+    def test_restarted_node_recovers_and_converges(self):
+        cfg = ThreadNetConfig(n_nodes=4, n_slots=60, k=8, f=0.5, seed=11,
+                              restart_plan=((25, 1), (40, 2)))
+        res = _run(cfg)
+        assert res.common_prefix_ok(cfg.k)
+        assert res.min_length() >= 15     # restarted nodes caught up
+        heads = [c.head_block_no for c in res.chains]
+        assert max(heads) - min(heads) <= 3
+
+    def test_restart_determinism_per_seed(self):
+        cfg = ThreadNetConfig(n_nodes=3, n_slots=40, k=8, f=0.5, seed=5,
+                              restart_plan=((20, 0),))
+        a = _run(cfg)
+        b = _run(cfg)
+        assert [c.head_point for c in a.chains] \
+            == [c.head_point for c in b.chains]
+
+
+@pytest.mark.slow
+class TestBaselineScale:
+    def test_ten_nodes_thousand_slots_with_restarts(self):
+        """BASELINE config #1: 10 nodes / 1k slots mock-Praos, plus two
+        mid-run restarts — convergence, bounded forks, chain growth."""
+        cfg = ThreadNetConfig(n_nodes=10, n_slots=1000, k=50, f=0.5,
+                              seed=42, topology="ring",
+                              chain_sync_window=16,
+                              restart_plan=((300, 3), (600, 7)))
+        res = _run(cfg)
+        assert res.common_prefix_ok(cfg.k)
+        assert res.max_fork_depth() <= 3
+        # chain growth: ~f*n_slots blocks expected; allow generous slack
+        assert res.min_length() >= 300
+        heads = [c.head_block_no for c in res.chains]
+        assert max(heads) - min(heads) <= 3
+
+
+class TestTypedTracers:
+    def test_two_node_sync_emits_decision_events(self):
+        from ouroboros_tpu import simharness as sim
+        from ouroboros_tpu.node import connect_nodes
+        from ouroboros_tpu.testing.threadnet import PraosNetworkFactory
+        from ouroboros_tpu.utils.tracer import (
+            NodeTracers, Tracer, TraceAddBlock, TraceChainSyncEvent,
+            TraceFetchDecision, TraceForgeEvent, collecting,
+        )
+        cfg = ThreadNetConfig(n_nodes=2, n_slots=20, k=8, f=0.7, seed=3)
+        factory = PraosNetworkFactory(cfg)
+
+        async def main():
+            forge_tr, forge_ev = collecting()
+            fetch_tr, fetch_ev = collecting()
+            cs_tr, cs_ev = collecting()
+            db_tr, db_ev = collecting()
+            a = factory.make_node(0)
+            a.tracers = NodeTracers(forge=forge_tr)
+            b = factory.make_node(1)
+            b.tracers = NodeTracers(fetch=fetch_tr, chain_sync=cs_tr)
+            b.chain_db.tracer = db_tr
+            # node 1 does NOT forge: it must sync everything from node 0
+            b.forgings = []
+            a.start()
+            b.start()
+            connect_nodes(a, b, delay=0.02)
+            await sim.sleep(cfg.n_slots * 1.0 + 2.0)
+            out = (forge_ev, fetch_ev, cs_ev, db_ev,
+                   a.chain_db.tip_point(), b.chain_db.tip_point())
+            a.stop()
+            b.stop()
+            return out
+
+        forge_ev, fetch_ev, cs_ev, db_ev, tip_a, tip_b = sim.run(
+            main(), seed=9)
+        assert tip_b == tip_a and tip_a.slot > 0
+        # the forger traced its forges
+        assert forge_ev and all(isinstance(e, TraceForgeEvent)
+                                and e.outcome == "forged"
+                                for e in forge_ev)
+        # the syncing node traced chainsync validation batches ...
+        assert cs_ev and all(isinstance(e, TraceChainSyncEvent)
+                             for e in cs_ev)
+        assert sum(e.n for e in cs_ev) >= len(forge_ev)
+        # ... fetch decisions with real request sizes ...
+        assert fetch_ev and all(isinstance(e, TraceFetchDecision)
+                                and e.n_requested >= 1
+                                for e in fetch_ev)
+        # ... and ChainDB add events for every adopted block
+        adds = [e for e in db_ev if isinstance(e, TraceAddBlock)]
+        assert adds and {e.kind for e in adds} <= {
+            "extended", "switched", "stored", "duplicate"}
+        assert sum(1 for e in adds if e.kind in ("extended", "switched")) \
+            >= 1
