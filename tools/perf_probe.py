@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Perf probe: repeated idle measurements of the Ed25519/VRF device paths.
+
+Times each (path, shape) with R repetitions and prints median + min/max —
+the measurement discipline VERDICT r3 asked for, in a standalone tool so
+kernel work can be steered by medians instead of single-shot noise.
+"""
+import argparse
+import hashlib
+import statistics
+import sys
+import time
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.abspath(__file__)) + "/..")
+
+
+def timed(fn, reps):
+    vals = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        vals.append(time.perf_counter() - t0)
+    return vals
+
+
+def report(name, n, vals):
+    med = statistics.median(vals)
+    spread = (max(vals) - min(vals)) / med if med else 0
+    print(f"{name:28s} n={n:5d}  median {n / med:9.1f}/s   "
+          f"min {n / max(vals):9.1f}/s  max {n / min(vals):9.1f}/s  "
+          f"spread {100 * spread:.0f}%", flush=True)
+    return n / med
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--n-ed", type=int, default=4096)
+    ap.add_argument("--n-vrf", type=int, default=2048)
+    ap.add_argument("--skip-vrf", action="store_true")
+    ap.add_argument("--skip-xla", action="store_true")
+    args = ap.parse_args()
+
+    import numpy as np
+    import jax.numpy as jnp
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+    from ouroboros_tpu.crypto import ed25519_jax as EJ
+    from ouroboros_tpu.crypto import ed25519_ref, vrf_ref
+    from ouroboros_tpu.crypto import pallas_kernels as PK
+    from ouroboros_tpu.crypto import vrf_jax
+
+    n = args.n_ed
+    sk = hashlib.sha256(b"probe").digest()
+    key = Ed25519PrivateKey.from_private_bytes(sk)
+    vk = ed25519_ref.public_key(sk)
+    msgs = [b"m%06d" % i for i in range(n)]
+    sigs = [key.sign(m) for m in msgs]
+    arrays, parse_ok = EJ.prepare_bytes_batch([vk] * n, msgs, sigs)
+    arrs = [jnp.asarray(a) for a in arrays]
+
+    # --- Ed25519 XLA path
+    if not args.skip_xla:
+        def run_xla():
+            ok = np.asarray(EJ.verify_full_kernel(*arrs))
+            assert ok.sum() == n, ok.sum()
+        run_xla()   # compile
+        report("ed25519 XLA", n, timed(run_xla, args.reps))
+
+    # --- Ed25519 pallas path
+    yA, signA, yR, signR, s_bits, k_bits = arrs
+
+    def run_pallas():
+        ok = np.asarray(PK.ed25519_verify_pallas(
+            yA, signA, yR, signR, s_bits, k_bits, n))
+        assert ok.sum() == n, ok.sum()
+    run_pallas()    # compile
+    report("ed25519 pallas", n, timed(run_pallas, args.reps))
+
+    if args.skip_vrf:
+        return
+    # --- VRF
+    nv = args.n_vrf
+    vsk = hashlib.sha256(b"probe-vrf").digest()
+    vvk = vrf_ref.public_key(vsk)
+    alphas = [b"a%d" % i for i in range(nv)]
+    proofs = [vrf_ref.prove(vsk, a) for a in alphas]
+
+    if not args.skip_xla:
+        def run_vrf_xla():
+            st = vrf_jax._submit([vvk] * nv, alphas, proofs, nv, runner=None)
+            oks, _ = vrf_jax._finish(*st, nv)
+            assert all(oks)
+        run_vrf_xla()
+        report("vrf XLA", nv, timed(run_vrf_xla, args.reps))
+
+    def run_vrf_pallas():
+        st = vrf_jax._submit([vvk] * nv, alphas, proofs, nv,
+                             runner=PK.vrf_verify_pallas)
+        oks, _ = vrf_jax._finish(*st, nv)
+        assert all(oks)
+    run_vrf_pallas()
+    report("vrf pallas", nv, timed(run_vrf_pallas, args.reps))
+
+
+if __name__ == "__main__":
+    main()
