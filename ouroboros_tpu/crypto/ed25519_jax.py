@@ -78,6 +78,52 @@ def _identity_like(ref):
 # The jitted kernel
 # ---------------------------------------------------------------------------
 
+def _smalls_of(P, n, ident):
+    """[identity, P, 2P, 3P] for a point batch (w=2 window digits)."""
+    P2 = pt_double(P)
+    P3 = pt_add(P2, P, n)
+    return (ident, P, P2, P3)
+
+
+def _const_smalls(x: int, y: int, n, ident):
+    """[identity, P, 2P, 3P] for a CONSTANT affine point — multiples
+    computed in Python ints, materialised as broadcast constants (no
+    device work)."""
+    out = [ident]
+    base = ed.from_affine(x, y)
+    for k in (1, 2, 3):
+        px, py = ed.to_affine(ed.scalar_mult(k, base))
+        out.append((F.const_batch(px, n), F.const_batch(py, n),
+                    F.one_like(ident[1]),
+                    F.const_batch(px * py % ed.P, n)))
+    return tuple(out)
+
+
+def joint_table_16(Bs, As, n):
+    """16-entry joint table T[4*j + i] = Bs[i] + As[j] (i = low digit
+    point multiple of the first scalar's base, j = second's).  Entries
+    where either side is the identity reuse the other side directly, so
+    the build costs 9 point additions."""
+    table = []
+    for j in range(4):
+        for i in range(4):
+            if i == 0:
+                table.append(As[j])
+            elif j == 0:
+                table.append(Bs[i])
+            else:
+                table.append(pt_add(Bs[i], As[j], n))
+    return table
+
+
+def _onehot_entry(table, idx, k):
+    """Sum-of-onehot select of a k-entry stacked table (XLA path; see
+    pallas_kernels._select16 for the where-chain form Mosaic prefers)."""
+    sel = (idx[None, :] == jnp.arange(k, dtype=jnp.int32)[:, None])
+    sel = sel.astype(jnp.int32)[:, None, :]                       # (k,1,N)
+    return tuple(jnp.sum(table[c] * sel, axis=0) for c in range(4))
+
+
 def verify_core(negA_x, negA_y, negA_t, Rx, Ry, s_bits, k_bits, nbits=256):
     """Q = [s]B + [k](-A); return projective diffs vs affine R.
 
@@ -85,34 +131,35 @@ def verify_core(negA_x, negA_y, negA_t, Rx, Ry, s_bits, k_bits, nbits=256):
     Returns (d1, d2): d1 = Rx*Z_Q - X_Q, d2 = Ry*Z_Q - Y_Q — verification
     succeeds iff both ≡ 0 (mod p) (host checks after unpack).
 
+    Windowed Strauss-Shamir, w = 2: nbits/2 iterations, each doing two
+    doublings and ONE addition of T[s_digit + 4*k_digit] from a 16-entry
+    joint table [i]B + [j](-A) — half the point additions of the 1-bit
+    form for ~11 extra table-build additions (VERDICT r3 next-step 2).
+
     Un-jitted so parallel/sharded_verify.py can wrap it in shard_map; use
     `verify_kernel` for the single-device jitted form.
     """
     n = negA_x.shape[1]
     one = F.const_batch(1, n)
     gx, gy = ed.to_affine(ed.BASE)
-    Bx = F.const_batch(gx, n)
-    By = F.const_batch(gy, n)
-    Bt = F.const_batch(gx * gy % ed.P, n)
     negA = (negA_x, negA_y, one, negA_t)
-    Bpt = (Bx, By, one, Bt)
-    T3 = pt_add(Bpt, negA, n)
     ident = _identity_like(negA_x)
-    # table (4, NLIMBS, N) per coordinate: [identity, B, -A, B-A]
-    table = tuple(jnp.stack([ident[c], Bpt[c], negA[c], T3[c]])
-                  for c in range(4))
+    Bs = _const_smalls(gx, gy, n, ident)
+    As = _smalls_of(negA, n, ident)
+    # stacked (16, NLIMBS, N) per coordinate: T[4j+i] = [i]B + [j](-A)
+    tbl = joint_table_16(Bs, As, n)
+    table = tuple(jnp.stack([t[c] for t in tbl]) for c in range(4))
 
     def body(i, Q):
-        Q = pt_double(Q)
-        sb = lax.dynamic_index_in_dim(s_bits, i, 0, keepdims=False)   # (N,)
-        kb = lax.dynamic_index_in_dim(k_bits, i, 0, keepdims=False)
-        idx = sb + 2 * kb
-        sel = (idx[None, :] == jnp.arange(4, dtype=jnp.int32)[:, None])
-        sel = sel.astype(jnp.int32)[:, None, :]                       # (4,1,N)
-        entry = tuple(jnp.sum(table[c] * sel, axis=0) for c in range(4))
-        return pt_add(Q, entry, n)
+        Q = pt_double(pt_double(Q))
+        s_hi = lax.dynamic_index_in_dim(s_bits, 2 * i, 0, keepdims=False)
+        s_lo = lax.dynamic_index_in_dim(s_bits, 2 * i + 1, 0, keepdims=False)
+        k_hi = lax.dynamic_index_in_dim(k_bits, 2 * i, 0, keepdims=False)
+        k_lo = lax.dynamic_index_in_dim(k_bits, 2 * i + 1, 0, keepdims=False)
+        idx = (2 * s_hi + s_lo) + 4 * (2 * k_hi + k_lo)
+        return pt_add(Q, _onehot_entry(table, idx, 16), n)
 
-    Q = lax.fori_loop(0, nbits, body, ident)
+    Q = lax.fori_loop(0, nbits // 2, body, ident)
     X, Y, Z, _ = Q
     d1 = F.sub(F.mul(Rx, Z), X)
     d2 = F.sub(F.mul(Ry, Z), Y)
@@ -237,37 +284,6 @@ def verify_kernel_full_submit(arrays):
     device array handle; np.asarray(handle) later blocks and fetches.  Lets
     callers pipeline host prep of the next batch under device execution."""
     return verify_full_kernel(*[jnp.asarray(a) for a in arrays])
-
-
-@jax.jit
-def dual_scalar_mult_kernel(p1x, p1y, p1t, p2x, p2y, p2t, a_bits, b_bits):
-    """Q = [a]P1 + [b]P2 for a whole batch; returns projective (X, Y, Z).
-
-    The general form of the Strauss ladder used by the VRF verifier, where
-    neither point is fixed: U = [s]B - [c]Y and V = [s]H - [c]Gamma
-    (vrf_ref.verify; Shelley/Protocol.hs:366-415 seam).
-    """
-    n = p1x.shape[1]
-    one = F.const_batch(1, n)
-    P1 = (p1x, p1y, one, p1t)
-    P2 = (p2x, p2y, one, p2t)
-    T3 = pt_add(P1, P2, n)
-    ident = _identity_like(p1x)
-    table = tuple(jnp.stack([ident[c], P1[c], P2[c], T3[c]])
-                  for c in range(4))
-
-    def body(i, Q):
-        Q = pt_double(Q)
-        ab = lax.dynamic_index_in_dim(a_bits, i, 0, keepdims=False)
-        bb = lax.dynamic_index_in_dim(b_bits, i, 0, keepdims=False)
-        idx = ab + 2 * bb
-        sel = (idx[None, :] == jnp.arange(4, dtype=jnp.int32)[:, None])
-        sel = sel.astype(jnp.int32)[:, None, :]
-        entry = tuple(jnp.sum(table[c] * sel, axis=0) for c in range(4))
-        return pt_add(Q, entry, n)
-
-    Q = lax.fori_loop(0, 256, body, ident)
-    return Q[0], Q[1], Q[2]
 
 
 # ---------------------------------------------------------------------------

@@ -89,10 +89,35 @@ def _select_bit(table, idx):
     return tuple(out)
 
 
+def _select16(table, idx):
+    """16-entry point-table select by 4-bit index (N,): two-stage
+    where-chain — pick within each 4-row group by the low 2 bits, then
+    across groups by the high 2 — 15 wheres per coordinate either way but
+    shorter dependence chains for the VPU."""
+    lo = idx & 3
+    hi = idx >> 2
+    out = []
+    for c in range(4):
+        groups = []
+        for g in range(4):
+            t = table[4 * g][c]
+            t = jnp.where((lo == 1)[None, :], table[4 * g + 1][c], t)
+            t = jnp.where((lo == 2)[None, :], table[4 * g + 2][c], t)
+            t = jnp.where((lo == 3)[None, :], table[4 * g + 3][c], t)
+            groups.append(t)
+        t = groups[0]
+        t = jnp.where((hi == 1)[None, :], groups[1], t)
+        t = jnp.where((hi == 2)[None, :], groups[2], t)
+        t = jnp.where((hi == 3)[None, :], groups[3], t)
+        out.append(t)
+    return tuple(out)
+
+
 def _ed25519_verify_kernel(yA_ref, signA_ref, yR_ref, signR_ref,
                            s_bits_ref, k_bits_ref, ok_ref):
     """One TILE of full Ed25519 verification: decompress A and R, run the
-    256-iteration dual-scalar ladder Q = [s]B + [k](-A), compare vs R."""
+    windowed (w=2, 128-iteration) dual-scalar ladder Q = [s]B + [k](-A)
+    over a 16-entry joint table, compare vs R."""
     n = TILE
     yA = yA_ref[:]
     yR = yR_ref[:]
@@ -104,20 +129,18 @@ def _ed25519_verify_kernel(yA_ref, signA_ref, yR_ref, signR_ref,
     nax = F.sub(yA * 0, xA)
     negA = (nax, yA, one, F.mul(nax, yA))
     gx, gy = ed.to_affine(ed.BASE)
-    Bpt = (F.const_batch(gx, n), F.const_batch(gy, n), one,
-           F.const_batch(gx * gy % ed.P, n))
-    T3 = _pt_add(Bpt, negA, n)
     ident = EJ._identity_like(yA)
-    table = (ident, Bpt, negA, T3)
+    Bs = EJ._const_smalls(gx, gy, n, ident)
+    As = EJ._smalls_of(negA, n, ident)
+    table = EJ.joint_table_16(Bs, As, n)      # T[4j+i] = [i]B + [j](-A)
 
     def body(i, Q):
-        Q = _pt_double(Q)
-        sb = s_bits_ref[i, :]
-        kb = k_bits_ref[i, :]
-        entry = _select_bit(table, sb + 2 * kb)
-        return _pt_add(Q, entry, n)
+        Q = _pt_double(_pt_double(Q))
+        idx = (2 * s_bits_ref[2 * i, :] + s_bits_ref[2 * i + 1, :]) \
+            + 4 * (2 * k_bits_ref[2 * i, :] + k_bits_ref[2 * i + 1, :])
+        return _pt_add(Q, _select16(table, idx), n)
 
-    Q = lax.fori_loop(0, 256, body, ident)
+    Q = lax.fori_loop(0, 128, body, ident)
     X, Y, Z, _ = Q
     d1 = F.sub(F.mul(xR, Z), X)
     d2 = F.sub(F.mul(yR, Z), Y)
