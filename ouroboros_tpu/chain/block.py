@@ -30,10 +30,16 @@ class Point:
         return self.slot < 0
 
     def encode(self):
+        """Reference wire grammar: origin = [], other points = [slot, hash]
+        (ouroboros-network/test/messages.cddl:152-155)."""
+        if self.is_genesis:
+            return []
         return [self.slot, self.hash]
 
     @classmethod
     def decode(cls, obj) -> "Point":
+        if len(obj) == 0:
+            return cls.genesis()
         return cls(int(obj[0]), bytes(obj[1]))
 
 
@@ -48,11 +54,17 @@ class Tip:
         return cls(Point.genesis(), -1)
 
     def encode(self):
-        return [self.point.encode(), self.block_no]
+        """tip = [point, uint] (messages.cddl:36); the genesis tip's
+        block number is clamped to 0 on the wire (uint), recovered as
+        Tip.genesis() on decode since origin admits no real block."""
+        return [self.point.encode(), max(self.block_no, 0)]
 
     @classmethod
     def decode(cls, obj) -> "Tip":
-        return cls(Point.decode(obj[0]), int(obj[1]))
+        p = Point.decode(obj[0])
+        if p.is_genesis:
+            return cls.genesis()
+        return cls(p, int(obj[1]))
 
 
 @runtime_checkable

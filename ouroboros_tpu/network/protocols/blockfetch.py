@@ -69,11 +69,15 @@ class MsgBlock:
     block: Block
 
     def encode_args(self):
-        return [self.block.encode()]
+        # msgBlock = [4, #6.24(bytes .cbor block)] (messages.cddl:55):
+        # blocks travel tag-24 CBOR-in-CBOR wrapped
+        from ...utils import cbor
+        return [cbor.Tag(24, cbor.dumps(self.block.encode()))]
 
     @classmethod
     def decode_args(cls, a):
-        return cls(Block.decode(a[0]))
+        from ...utils import cbor
+        return cls(Block.decode(cbor.unwrap_tag24(a[0])))
 
 
 @dataclass(frozen=True)
@@ -112,7 +116,8 @@ def make_codec(block_decode) -> Codec:
     class _Block(MsgBlock):
         @classmethod
         def decode_args(cls, a):
-            return cls(block_decode(a[0]))
+            from ...utils import cbor
+            return cls(block_decode(cbor.unwrap_tag24(a[0])))
     _Block.__name__ = "MsgBlock"
     return Codec([MsgRequestRange, MsgClientDone, MsgStartBatch,
                   MsgNoBlocks, _Block, MsgBatchDone])

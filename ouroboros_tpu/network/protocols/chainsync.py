@@ -46,11 +46,17 @@ class MsgRollForward:
     tip: Tip
 
     def encode_args(self):
-        return [self.header.encode(), self.tip.encode()]
+        # wrappedHeader = #6.24(bytes .cbor blockHeader): the header rides
+        # inside a tag-24 CBOR-in-CBOR envelope (messages.cddl:34)
+        from ...utils import cbor
+        return [cbor.Tag(24, cbor.dumps(self.header.encode())),
+                self.tip.encode()]
 
     @classmethod
     def decode_args(cls, a):
-        return cls(BlockHeader.decode(a[0]), Tip.decode(a[1]))
+        from ...utils import cbor
+        return cls(BlockHeader.decode(cbor.unwrap_tag24(a[0])),
+                   Tip.decode(a[1]))
 
 
 @dataclass(frozen=True)
@@ -149,7 +155,9 @@ def make_codec(header_decode) -> Codec:
     class _RollForward(MsgRollForward):
         @classmethod
         def decode_args(cls, a):
-            return cls(header_decode(a[0]), Tip.decode(a[1]))
+            from ...utils import cbor
+            return cls(header_decode(cbor.unwrap_tag24(a[0])),
+                       Tip.decode(a[1]))
     _RollForward.__name__ = "MsgRollForward"
     return Codec([MsgRequestNext, MsgAwaitReply, _RollForward,
                   MsgRollBackward, MsgFindIntersect, MsgIntersectFound,

@@ -19,11 +19,13 @@ class MsgProposeVersions:
     versions: tuple   # ((version_number, params_cbor), ...) ascending
 
     def encode_args(self):
-        return [[[v, p] for v, p in self.versions]]
+        # versionTable is a CBOR MAP with unique ascending keys
+        # (messages.cddl:108-115; Handshake/Codec.hs)
+        return [{v: p for v, p in sorted(self.versions)}]
 
     @classmethod
     def decode_args(cls, a):
-        return cls(tuple((int(v), p) for v, p in a[0]))
+        return cls(tuple(sorted((int(v), p) for v, p in a[0].items())))
 
 
 @dataclass(frozen=True)
@@ -40,17 +42,62 @@ class MsgAcceptVersion:
         return cls(int(a[0]), a[1])
 
 
+# refuseReason variants (messages.cddl:117-123)
+
+@dataclass(frozen=True)
+class RefuseVersionMismatch:
+    """[0, [*versionNumber]] — no common version; carries ours."""
+    TAG = 0
+    versions: tuple = ()
+
+    def encode(self):
+        return [0, list(self.versions)]
+
+
+@dataclass(frozen=True)
+class RefuseHandshakeDecodeError:
+    """[1, versionNumber, tstr]."""
+    TAG = 1
+    version: int = 0
+    message: str = ""
+
+    def encode(self):
+        return [1, self.version, self.message]
+
+
+@dataclass(frozen=True)
+class RefuseRefused:
+    """[2, versionNumber, tstr] — version acceptable but params refused."""
+    TAG = 2
+    version: int = 0
+    message: str = ""
+
+    def encode(self):
+        return [2, self.version, self.message]
+
+
+def _decode_reason(obj):
+    tag = int(obj[0])
+    if tag == 0:
+        return RefuseVersionMismatch(tuple(int(v) for v in obj[1]))
+    if tag == 1:
+        return RefuseHandshakeDecodeError(int(obj[1]), str(obj[2]))
+    if tag == 2:
+        return RefuseRefused(int(obj[1]), str(obj[2]))
+    raise ValueError(f"unknown refuse reason tag {tag}")
+
+
 @dataclass(frozen=True)
 class MsgRefuse:
     TAG = 2
-    reason: str
+    reason: Any       # one of the Refuse* dataclasses
 
     def encode_args(self):
-        return [self.reason]
+        return [self.reason.encode()]
 
     @classmethod
     def decode_args(cls, a):
-        return cls(str(a[0]))
+        return cls(_decode_reason(a[0]))
 
 
 SPEC = ProtocolSpec(
@@ -105,8 +152,9 @@ async def server_accept(session, versions: Versions,
     msg = await session.recv()
     chosen = policy(versions, msg.versions)
     if chosen is None:
-        await session.send(MsgRefuse("no common version"))
-        return ("refused", "no common version")
+        reason = RefuseVersionMismatch(tuple(versions.numbers()))
+        await session.send(MsgRefuse(reason))
+        return ("refused", reason)
     params, _app = versions.get(chosen)
     await session.send(MsgAcceptVersion(chosen, params))
     return ("accepted", chosen, dict(msg.versions).get(chosen))

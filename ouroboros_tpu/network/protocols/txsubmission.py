@@ -48,7 +48,10 @@ class MsgRequestTxs:
     ids: tuple
 
     def encode_args(self):
-        return [list(self.ids)]
+        # tsIdList must use indefinite-length framing — the reference
+        # codec accepts nothing else (messages.cddl:78 note)
+        from ...utils.cbor import IndefList
+        return [IndefList(self.ids)]
 
     @classmethod
     def decode_args(cls, a):
@@ -61,7 +64,8 @@ class MsgReplyTxs:
     txs: tuple             # opaque tx bytes
 
     def encode_args(self):
-        return [list(self.txs)]
+        from ...utils.cbor import IndefList
+        return [IndefList(self.txs)]
 
     @classmethod
     def decode_args(cls, a):

@@ -52,6 +52,12 @@ def _head(major: int, arg: int) -> bytes:
     raise CBORError("integer too large for CBOR head")
 
 
+class IndefList(list):
+    """A list encoded with indefinite length (0x9f ... 0xff) — some
+    reference codecs REQUIRE this framing (e.g. TxSubmission's tsIdList,
+    ouroboros-network/test/messages.cddl:78 note)."""
+
+
 def _encode(obj: Any, out: bytearray) -> None:
     if obj is None:
         out.append(0xF6)
@@ -71,6 +77,11 @@ def _encode(obj: Any, out: bytearray) -> None:
         raw = obj.encode("utf-8")
         out += _head(3, len(raw))
         out += raw
+    elif isinstance(obj, IndefList):
+        out.append(0x9F)
+        for item in obj:
+            _encode(item, out)
+        out.append(0xFF)
     elif isinstance(obj, (list, tuple)):
         out += _head(4, len(obj))
         for item in obj:
@@ -180,6 +191,16 @@ def loads(data: bytes, allow_trailing: bool = False):
     obj = dec.decode()
     if not allow_trailing and dec.pos != len(data):
         raise CBORError(f"trailing bytes after CBOR value at {dec.pos}")
+    return obj
+
+
+def unwrap_tag24(obj):
+    """CBOR-in-CBOR unwrap (#6.24(bytes .cbor x), messages.cddl:34,55):
+    returns the decoded inner value for a tag-24-over-bytes envelope,
+    or the object unchanged otherwise."""
+    if isinstance(obj, Tag) and obj.tag == 24 and isinstance(obj.value,
+                                                             bytes):
+        return loads(obj.value)
     return obj
 
 

@@ -64,7 +64,7 @@ def _corpus():
     out["handshake"] = [
         hs.MsgProposeVersions(((7, b"\x0a"), (8, b"\x0b"))),
         hs.MsgAcceptVersion(8, b"\x0b"),
-        hs.MsgRefuse("VersionMismatch"),
+        hs.MsgRefuse(hs.RefuseVersionMismatch((7, 8))),
     ]
     out["localstatequery"] = [
         lsq.MsgAcquire(P1), lsq.MsgAcquired(), lsq.MsgFailure("pointTooOld"),
@@ -140,12 +140,12 @@ def digests():
 
 
 EXPECTED = {
-    "chainsync": "cb26b34abee49febbf267a1032e4b26f3dec159bb56ebae3a2a459cd000100f0",
-    "blockfetch": "1260c8b9e1066ae24682676189451deb4d4e15ab465b8a4741db2714a646faca",
-    "txsubmission": "8033c0356409dbec371d1f4506a4a6297890c1a1ba6c26f530098097c83c33e2",
-    "txsubmission2": "0d8ebf4307ad94ada1f3363de80c17849a5d5dcce578ed6479d03dbb0a437931",
+    "chainsync": "b0cf10f03c1f43635c0ed2d8d0510768a132ba1ac40d237de0fa6dc0ec354d14",
+    "blockfetch": "370c4a8249dada8f4e1a6877c508b2761ca5fe5fe3c127632f7667417007eb30",
+    "txsubmission": "2f2649fb830cdd6d607d0b97fdec021456fd314d21091b953481ef610da7d9ad",
+    "txsubmission2": "c7f87045c404e722fd543aab69f2c4872cfdefca018e0b228be475b31c3c799e",
     "keepalive": "07785ca61706e8b8978e443757c8932e5c157b8452480f3c4fbdf18ae98e4240",
-    "handshake": "b28442145e0ba3845b6ada0d5c8fddb58056122cd9834f25fbb018da896af3df",
+    "handshake": "12b0b8b28748f681b43bcb1b1c47edc37317903e9abf5f8aadb7dec888cfe8aa",
     "localstatequery": "b7fc8bc8a88b9e3e0f64ccf7562bfe0d49f35ce9e6eba6318838d0444137c7b5",
     "localtxsubmission": "2f7ef01c240b2671ab4043d2a0812d747538f26237d4fae48e875c0dbd292e34",
     "localtxmonitor": "e71b38f3e981217c9bda46ba8e8adb38ce9604a2a31e9c7ce86b14c1a8081d1a",

@@ -53,7 +53,7 @@ def test_codec_roundtrips_all_protocols():
         (handshake.CODEC, [
             handshake.MsgProposeVersions(((7, {"net": 42}), (8, None))),
             handshake.MsgAcceptVersion(8, {"net": 42}),
-            handshake.MsgRefuse("nope")]),
+            handshake.MsgRefuse(handshake.RefuseRefused(8, "nope"))]),
         (localstatequery.CODEC, [
             localstatequery.MsgAcquire(p), localstatequery.MsgAcquire(None),
             localstatequery.MsgAcquired(), localstatequery.MsgFailure("x"),
@@ -217,7 +217,7 @@ def test_handshake_no_common_version():
                 s, handshake.Versions().add(2, None)))
 
     cres, sres = sim.run(main())
-    assert cres == ("refused", "no common version")
+    assert cres == ("refused", handshake.RefuseVersionMismatch((2,)))
 
 
 def test_localstatequery_acquire_query():
