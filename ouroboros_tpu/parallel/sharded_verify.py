@@ -114,17 +114,19 @@ class ShardedJaxBackend(CryptoBackend):
     through the inherited verify_mixed unchanged — the batching seam is
     mesh-agnostic).
 
-    The pipelined single-transfer path (submit_window) is deliberately
-    absent: on a real multi-chip slice the host<->device link is local
-    PCIe and the per-kind calls are cheap; the fallback windowed driver is
-    used by replay."""
-
-    submit_window = None                 # force the non-pipelined driver
+    The pipelined single-transfer path (submit_window/finish_window) is
+    mesh-sharded too: one jitted program per window shape runs the Ed25519
+    ladder + VRF ladders + next-window gamma8 with every batch sharded
+    over the window axis, packing all results into ONE flat uint8 array —
+    one launch and one host transfer per window regardless of mesh size
+    (VERDICT r3 next-step 5; on a tunneled or multi-host link the fixed
+    per-dispatch latency dominates exactly as on one chip)."""
 
     def __init__(self, mesh: Mesh, min_bucket: int = 128):
         self.mesh = mesh
         self.name = f"jax-mesh-{mesh.devices.size}"
         self.min_bucket = min_bucket
+        self._composites: dict = {}      # (ne, nv, nb) -> fused program
 
     def _pad(self, n: int) -> int:
         d = self.mesh.devices.size
@@ -182,3 +184,120 @@ class ShardedJaxBackend(CryptoBackend):
                       jax.device_put(np.asarray(signG), s1))
         handle, decode_ok = vrf_jax._submit_betas(padded, m, runner=runner)
         return vrf_jax._finish_betas(np.asarray(handle), decode_ok, n)
+
+    # -- pipelined single-transfer window path ------------------------------
+
+    def _window_composite(self, ne: int, nv: int, nb: int):
+        """One jitted mesh program for a whole window (see
+        crypto.jax_backend.JaxBackend._window_composite for the packed
+        layout it must reproduce)."""
+        key = (ne, nv, nb)
+        fn = self._composites.get(key)
+        if fn is not None:
+            return fn
+        from ..crypto import vrf_jax
+        mesh = self.mesh
+        axis = mesh.axis_names[0]
+        spec2 = P(None, axis)
+        spec1 = P(axis)
+
+        ed_mapped = jax.shard_map(
+            EJ.verify_full_core, mesh=mesh,
+            in_specs=(spec2, spec1, spec2, spec1, spec2, spec2),
+            out_specs=spec1) if ne else None
+        vrf_mapped = jax.shard_map(
+            vrf_jax.vrf_verify_core, mesh=mesh,
+            in_specs=(spec2, spec1, spec2, spec1, spec2, spec2, spec2,
+                      spec2),
+            out_specs=P(axis, None)) if nv else None
+        beta_mapped = jax.shard_map(
+            vrf_jax.gamma8_kernel.__wrapped__, mesh=mesh,
+            in_specs=(spec2, spec1),
+            out_specs=P(axis, None)) if nb else None
+
+        def call(ed_args, vrf_args, beta_args):
+            parts = []
+            if ed_args is not None:
+                yA, signA2, yR, signR2, sb, kb = ed_args
+                ok = ed_mapped(yA, signA2[0], yR, signR2[0], sb, kb)
+                parts.append(ok.reshape(-1).astype(jnp.uint8))
+            if vrf_args is not None:
+                yY, sY2, yG, sG2, r, cb, lob, hib = vrf_args
+                rows = vrf_mapped(yY, sY2[0], yG, sG2[0], r, cb, lob, hib)
+                parts.append(rows.reshape(-1))
+            if beta_args is not None:
+                byG, bsG2 = beta_args
+                parts.append(beta_mapped(byG, bsG2[0]).reshape(-1))
+            return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+        fn = jax.jit(call)
+        self._composites[key] = fn
+        return fn
+
+    def submit_window(self, reqs, next_beta_proofs=()):
+        """Mesh-sharded analog of JaxBackend.submit_window: same host
+        prep, same packed result layout, batches sharded over the window
+        axis.  Returns the opaque state finish_window consumes."""
+        from ..crypto import vrf_jax
+        ed_reqs, ed_owner, vrf_reqs, vrf_owner, n = self.split_mixed(reqs)
+        beta_proofs = list(dict.fromkeys(next_beta_proofs))
+        ed_state = vrf_state = beta_state = None
+        ne = nv = nb = 0
+        ed_args = vrf_args = beta_args = None
+        axis = self.mesh.axis_names[0]
+        s2 = NamedSharding(self.mesh, P(None, axis))
+
+        def put2(a):
+            return jax.device_put(np.asarray(a), s2)
+
+        if ed_reqs:
+            ne = self._pad(len(ed_reqs))
+            pad = ne - len(ed_reqs)
+            arrays, parse_ok = EJ.prepare_bytes_batch(
+                [r.vk for r in ed_reqs] + [b"\x00" * 32] * pad,
+                [r.msg for r in ed_reqs] + [b""] * pad,
+                [r.sig for r in ed_reqs] + [b"\x00" * 64] * pad)
+            ed_state = (None, parse_ok)
+            yA, signA, yR, signR, s_bits, k_bits = arrays
+            ed_args = (put2(yA),
+                       jax.device_put(signA.reshape(1, -1), s2),
+                       put2(yR),
+                       jax.device_put(signR.reshape(1, -1), s2),
+                       put2(s_bits), put2(k_bits))
+        if vrf_reqs:
+            nv = self._pad(len(vrf_reqs))
+            pad = nv - len(vrf_reqs)
+            args, parse_ok, gamma_ok, s_ok, pf_arr = vrf_jax._prepare(
+                [r.vk for r in vrf_reqs] + [b"\x00" * 32] * pad,
+                [r.alpha for r in vrf_reqs] + [b""] * pad,
+                [r.proof for r in vrf_reqs] + [b"\x00" * 80] * pad)
+            vrf_state = (None, parse_ok, gamma_ok, s_ok, pf_arr)
+            yY, signY, yG, signG, r_l, c_b, lo_b, hi_b = args
+            vrf_args = (put2(yY),
+                        jax.device_put(signY.reshape(1, -1), s2),
+                        put2(yG),
+                        jax.device_put(signG.reshape(1, -1), s2),
+                        put2(r_l), put2(c_b), put2(lo_b), put2(hi_b))
+        if beta_proofs:
+            nb = self._pad(len(beta_proofs))
+            padded = beta_proofs + [b"\x00" * 80] * (nb - len(beta_proofs))
+            (yG, signG), decode_ok = vrf_jax._prepare_betas(padded)
+            beta_state = (decode_ok,)
+            beta_args = (put2(yG),
+                         jax.device_put(signG.reshape(1, -1), s2))
+        if ed_args is None and vrf_args is None and beta_args is None:
+            packed = None
+        else:
+            packed = self._window_composite(ne, nv, nb)(
+                ed_args, vrf_args, beta_args)
+        return {"packed": packed, "n": n,
+                "ed": ed_state, "ed_owner": ed_owner, "ne": ne,
+                "vrf": vrf_state, "vrf_owner": vrf_owner,
+                "vrf_n": len(vrf_reqs), "nv": nv,
+                "beta": beta_state, "beta_proofs": beta_proofs, "nb": nb}
+
+    # identical packed layout -> identical host-side unpacking
+    from ..crypto.jax_backend import JaxBackend as _JB
+    finish_window = _JB.finish_window
+    verify_mixed = _JB.verify_mixed
+    del _JB

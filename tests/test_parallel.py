@@ -103,3 +103,40 @@ def test_sharded_ed25519_thousands_of_proofs():
     sigs[2049] = sigs[2049][:20] + b"\x00" + sigs[2049][21:]
     got = sharded_batch_verify([vk] * n, msgs, sigs, mesh)
     assert got == [i != 2049 for i in range(n)]
+
+
+def test_sharded_submit_window_pipelines():
+    """The mesh backend's packed single-transfer window path: one
+    submit_window dispatch carries Ed25519+VRF+KES AND the next window's
+    betas; finish_window unpacks with host parity (VERDICT r3 #5)."""
+    import hashlib
+
+    from ouroboros_tpu.crypto import ed25519_ref, kes, vrf_ref
+    from ouroboros_tpu.crypto.backend import (
+        CpuRefBackend, Ed25519Req, KesReq, VrfReq,
+    )
+    from ouroboros_tpu.parallel import ShardedJaxBackend, make_mesh
+
+    mesh = make_mesh(8)
+    sb = ShardedJaxBackend(mesh, min_bucket=16)
+    sk = hashlib.sha256(b"win-ed").digest()
+    vk = ed25519_ref.public_key(sk)
+    vsk = hashlib.sha256(b"win-vrf").digest()
+    vvk = vrf_ref.public_key(vsk)
+    ksk = kes.KesSignKey(2, hashlib.sha256(b"win-kes").digest())
+    reqs = []
+    next_proofs = []
+    for i in range(5):
+        m = b"w%d" % i
+        reqs.append(Ed25519Req(vk, m, ed25519_ref.sign(sk, m)))
+        reqs.append(VrfReq(vvk, m, vrf_ref.prove(vsk, m)))
+        reqs.append(KesReq(2, ksk.verification_key, 0, m,
+                           ksk.sign(m).to_bytes()))
+        next_proofs.append(vrf_ref.prove(vsk, b"next%d" % i))
+    reqs[6] = Ed25519Req(vk, b"other", reqs[0].sig)     # one bad
+    st = sb.submit_window(reqs, next_beta_proofs=next_proofs)
+    ok, betas = sb.finish_window(st)
+    assert ok == CpuRefBackend().verify_mixed(reqs)
+    assert set(betas) == set(next_proofs)
+    for p, b in betas.items():
+        assert b == vrf_ref.proof_to_hash(p)
