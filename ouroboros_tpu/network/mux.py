@@ -173,6 +173,17 @@ class Mux:
         for j in self._jobs:
             j.cancel()
 
+    async def wait_closed(self) -> None:
+        """Block until the demuxer job ends — i.e. the bearer EOFed or
+        errored (the connection-down signal servers hold on).  Returns
+        immediately if the mux was never started."""
+        if len(self._jobs) < 2:
+            return
+        try:
+            await self._jobs[1].wait()
+        except BaseException:
+            pass
+
     async def _egress_loop(self):
         """Round-robin over channels; one SDU per channel per cycle
         (Egress.hs:77-105 fairness)."""

@@ -1,11 +1,19 @@
 """Diffusion — compose the node's whole network surface.
 
-Reference: ouroboros-network/src/Ouroboros/Network/Diffusion.hs:119-245
-(`runDataDiffusion` composes: IOManager, snockets, local server for
-wallets, IP/DNS subscription workers for outbound, accept servers for
-inbound, error policies) — here over the in-sim address registry (the
-Snocket seam: a socket transport plugs into `SimNetwork.dial` the same
-way).
+Reference: ouroboros-network/src/Ouroboros/Network/Diffusion.hs:119-245.
+`runDataDiffusion` composes, in one record-driven call: the snocket layer,
+a LOCAL server for wallets (node-to-client), per-address ACCEPT servers
+for inbound node-to-node (initiator-and-responder mode only), an IP
+subscription worker and per-domain DNS subscription workers for outbound,
+with shared connection tables, accept limits and error policies.
+
+This is that composition over this repo's Snocket trait, so the same
+`run_data_diffusion` runs deterministically in-sim (SimSnocket) and over
+real TCP/Unix sockets (TcpSnocket/UnixSnocket under the IO runtime) —
+tests/test_diffusion.py drives both.
+
+The older SimNetwork address-registry path is kept for tests that wire
+kernels directly without bearers.
 """
 from __future__ import annotations
 
@@ -14,9 +22,235 @@ from typing import Dict, Optional, Sequence
 
 from .. import simharness as sim
 from ..network.error_policy import default_node_policies
-from ..network.subscription import SubscriptionWorker
-from .kernel import NodeKernel, _connect_directional
+from ..network.mux import Mux
+from ..network.snocket import (
+    AcceptLimits, ConnectionTable, Listener, Snocket, run_server,
+)
+from ..network.subscription import (
+    Resolver, SubscriptionWorker, dns_subscription_targets,
+)
+from .kernel import NodeKernel, _connect_directional, _run_initiator, \
+    _run_responder
+from .node_to_client import serve_node_to_client
 
+INITIATOR_AND_RESPONDER = "initiator-and-responder"   # DiffusionMode
+INITIATOR_ONLY = "initiator-only"
+
+
+@dataclass
+class DiffusionArguments:
+    """Diffusion.hs:119 `DiffusionArguments`: everything the node's
+    network surface needs, as one typed record."""
+    addresses: Sequence = ()           # listen addrs (daIPv4/daIPv6Address)
+    local_address: object = None       # daLocalAddress (node-to-client)
+    ip_producers: Sequence = ()        # daIpProducers dial targets
+    ip_valency: int = 2
+    dns_producers: Sequence = ()       # daDnsProducers domain names
+    dns_valency: int = 2
+    accept_limits: AcceptLimits = field(default_factory=AcceptLimits)
+    mode: str = INITIATOR_AND_RESPONDER   # daDiffusionMode
+    error_policies: Optional[list] = None
+
+
+@dataclass
+class Diffusion:
+    """Handle on a running diffusion: its workers, servers and tables."""
+    threads: list = field(default_factory=list)
+    workers: list = field(default_factory=list)
+    listeners: list = field(default_factory=list)
+    tables: dict = field(default_factory=dict)
+
+    def stop(self) -> None:
+        for t in self.threads:
+            t.cancel()
+        for lst in self.listeners:
+            lst.close()
+
+
+async def _hold_connection(mux: Mux, runner) -> None:
+    """Run a connection's application, then hold the bearer open until
+    the mux's demuxer ends (bearer EOF/error = connection down), so
+    run_server's finally can free the ConnectionTable slot and close the
+    bearer (Socket.hs keeps the fd open until the application completes).
+    A refused handshake releases the connection immediately."""
+    try:
+        outcome = await runner
+        if outcome != "refused":
+            await mux.wait_closed()
+    finally:
+        mux.stop()
+
+
+def _dialer(kernel: NodeKernel, snocket: Snocket, label: str):
+    """connectToNode over a snocket: dial -> mux -> initiator app.
+    Returns the dial function the subscription workers drive."""
+    def dial(addr):
+        async def conn():
+            bearer = await snocket.connect(addr)
+            peer_id = f"{kernel.label}->{addr}"
+            mux = Mux(bearer, f"{peer_id}.mux")
+            mux.start()
+            try:
+                await _run_initiator(kernel, mux, peer_id)
+            finally:
+                mux.stop()
+                close = getattr(bearer, "close", None)
+                if close:
+                    close()
+        return sim.spawn(conn(), label=f"{label}-dial-{addr}")
+    return dial
+
+
+async def run_data_diffusion(kernel: NodeKernel, args: DiffusionArguments,
+                             snocket: Snocket,
+                             local_snocket: Optional[Snocket] = None,
+                             resolver: Optional[Resolver] = None,
+                             ) -> Diffusion:
+    """The full composition (runDataDiffusion, Diffusion.hs:175-245):
+
+    - local node-to-client server on args.local_address
+    - accept server per args.addresses entry (responder mode only)
+    - one IP subscription worker over args.ip_producers
+    - one DNS subscription worker per args.dns_producers domain
+    - shared remote/local connection tables + accept limits + policies
+    """
+    d = Diffusion()
+    if args.dns_producers and resolver is None:
+        raise ValueError("dns_producers given but no resolver — pass a "
+                         "Resolver (DictResolver in sim, "
+                         "GetAddrInfoResolver for real DNS)")
+    policies = args.error_policies if args.error_policies is not None \
+        else default_node_policies()
+    remote_table = ConnectionTable()
+    local_table = ConnectionTable()
+    d.tables = {"remote": remote_table, "local": local_table}
+    local_snocket = local_snocket or snocket
+
+    # -- local server for wallets (Diffusion.hs:214 runLocalServer)
+    if args.local_address is not None:
+        lst = await local_snocket.listen(args.local_address)
+        d.listeners.append(lst)
+
+        async def local_handler(bearer, remote):
+            mux = Mux(bearer, f"{kernel.label}.local.{remote}")
+            mux.start()
+            threads = serve_node_to_client(
+                kernel, mux, label=f"{kernel.label}.local.{remote}")
+            # threads[0] = the accept thread; its result is the handshake
+            # outcome, so refused wallets release their slot immediately
+            await _hold_connection(mux, threads[0].wait())
+
+        d.threads.append(sim.spawn(
+            run_server(lst, local_handler, table=local_table,
+                       limits=args.accept_limits),
+            label=f"{kernel.label}-local-server"))
+
+    # -- accept servers per address (Diffusion.hs:225 runServer)
+    if args.mode == INITIATOR_AND_RESPONDER:
+        for addr in args.addresses:
+            lst = await snocket.listen(addr)
+            d.listeners.append(lst)
+
+            async def handler(bearer, remote):
+                peer_id = f"{kernel.label}<-{remote}"
+                mux = Mux(bearer, f"{peer_id}.mux")
+                mux.start()
+                await _hold_connection(
+                    mux, _run_responder(kernel, mux, peer_id))
+
+            d.threads.append(sim.spawn(
+                run_server(lst, handler, table=remote_table,
+                           limits=args.accept_limits),
+                label=f"{kernel.label}-server-{addr}"))
+
+    # -- IP subscription worker (Diffusion.hs:217 runIpSubscriptionWorker)
+    dial = _dialer(kernel, snocket, kernel.label)
+    if args.ip_producers:
+        w = SubscriptionWorker(
+            targets=list(args.ip_producers), valency=args.ip_valency,
+            dial=dial, error_policies=policies,
+            label=f"{kernel.label}-ip-subscription")
+        d.workers.append(w)
+        d.threads.append(sim.spawn(
+            w.run(), label=f"{kernel.label}-ip-subscription"))
+
+    # -- DNS subscription workers (Diffusion.hs:220)
+    for name in args.dns_producers:
+        async def dns_worker(name=name):
+            targets = await dns_subscription_targets(resolver, [name])
+            if not targets:
+                sim.trace_event(("dns-no-targets", kernel.label, name))
+                return
+            w = SubscriptionWorker(
+                targets=targets, valency=args.dns_valency, dial=dial,
+                error_policies=policies,
+                label=f"{kernel.label}-dns-{name}")
+            d.workers.append(w)
+            await w.run()
+        d.threads.append(sim.spawn(
+            dns_worker(), label=f"{kernel.label}-dns-subscription-{name}"))
+
+    kernel._threads.extend(d.threads)
+    return d
+
+
+async def connect_local_client_via(snocket: Snocket, addr, kernel_info,
+                                   label: str = "wallet"):
+    """Wallet-side dial of a diffusion's local address: connect over the
+    snocket, negotiate node-to-client, return a LocalClient
+    (cardano-client Subscription.subscribe's connection phase, but over
+    the diffusion's real local server rather than an in-memory pair).
+
+    kernel_info: (network_magic, block_decode_obj) — what the client
+    needs to know about the node's chain encoding."""
+    from ..network import node_to_node as n2n
+    from ..network.mux import INITIATOR, CodecChannel
+    from ..network.protocols import chainsync as cs_proto
+    from ..network.protocols import handshake as hs_proto
+    from ..network.protocols import localstatequery as lsq_proto
+    from ..network.protocols import localtxsubmission as ltx_proto
+    from ..network.typed import CLIENT, Session
+    from .node_to_client import NODE_TO_CLIENT_V1, LocalClient
+
+    network_magic, block_decode_obj = kernel_info
+    bearer = await snocket.connect(addr)
+    mux_c = Mux(bearer, f"{label}.mux")
+    mux_c.start()
+    versions = hs_proto.Versions().add(NODE_TO_CLIENT_V1,
+                                       {"magic": network_magic})
+    hs = Session(hs_proto.SPEC, CLIENT,
+                 CodecChannel(mux_c.channel(n2n.HANDSHAKE_NUM, INITIATOR),
+                              hs_proto.CODEC))
+    res = await hs_proto.client_propose(hs, versions)
+    if res[0] != "accepted":
+        mux_c.stop()
+        close = getattr(bearer, "close", None)
+        if close:
+            close()
+        return None
+    cs_codec = cs_proto.make_codec(block_decode_obj) if block_decode_obj \
+        else cs_proto.CODEC
+    return LocalClient(
+        mux=mux_c,
+        chain_sync=Session(
+            cs_proto.SPEC, CLIENT,
+            CodecChannel(mux_c.channel(n2n.LOCAL_CHAINSYNC_NUM,
+                                       INITIATOR), cs_codec)),
+        state_query=Session(
+            lsq_proto.SPEC, CLIENT,
+            CodecChannel(mux_c.channel(n2n.LOCAL_STATEQUERY_NUM,
+                                       INITIATOR), lsq_proto.CODEC)),
+        tx_submission=Session(
+            ltx_proto.SPEC, CLIENT,
+            CodecChannel(mux_c.channel(n2n.LOCAL_TXSUBMISSION_NUM,
+                                       INITIATOR), ltx_proto.CODEC)),
+        version=res[1])
+
+
+# ---------------------------------------------------------------------------
+# Legacy in-sim address registry (pre-snocket wiring; kept for tests that
+# connect kernels without bearers)
+# ---------------------------------------------------------------------------
 
 class SimNetwork:
     """Address registry standing in for the Snocket layer: maps addresses
@@ -42,37 +276,21 @@ class SimNetwork:
         return dial
 
 
-@dataclass
-class DiffusionArguments:
-    """Diffusion.hs:119 `DiffusionArguments` analog."""
-    address: object                          # our listening address
-    ip_targets: Sequence = ()                # peers to maintain
-    valency: int = 2
-    error_policies: Optional[list] = None
-
-
-@dataclass
-class Diffusion:
-    worker: Optional[SubscriptionWorker]
-    threads: list = field(default_factory=list)
-
-
-def run_data_diffusion(kernel: NodeKernel, network: SimNetwork,
-                       args: DiffusionArguments) -> Diffusion:
-    """Register the accept side, start outbound subscription maintenance
-    (runDataDiffusion's composition, minus OS specifics)."""
-    network.listen(args.address, kernel)
-    worker = None
-    if args.ip_targets:
+def run_sim_diffusion(kernel: NodeKernel, network: SimNetwork,
+                      address, ip_targets=(), valency: int = 2,
+                      error_policies=None) -> Diffusion:
+    """SimNetwork-based composition (the pre-round-4 surface)."""
+    network.listen(address, kernel)
+    d = Diffusion()
+    if ip_targets:
         worker = SubscriptionWorker(
-            targets=list(args.ip_targets),
-            valency=args.valency,
+            targets=list(ip_targets), valency=valency,
             dial=network.make_dial(kernel),
-            error_policies=(args.error_policies
-                            if args.error_policies is not None
+            error_policies=(error_policies if error_policies is not None
                             else default_node_policies()),
             label=f"{kernel.label}-subscription")
         t = sim.spawn(worker.run(), label=f"{kernel.label}-subscription")
         kernel._threads.append(t)
-        return Diffusion(worker, [t])
-    return Diffusion(worker)
+        d.workers.append(worker)
+        d.threads.append(t)
+    return d

@@ -390,7 +390,7 @@ async def _run_responder(responder: NodeKernel, mux_r, peer_id) -> None:
     if res[0] != "accepted":
         sim.trace_event(("handshake-refused", responder.label, peer_id,
                          res[1]))
-        return
+        return "refused"
     version = res[1]
 
     hdr_dec = responder.header_decode
@@ -428,6 +428,7 @@ async def _run_responder(responder: NodeKernel, mux_r, peer_id) -> None:
         responder._threads.append(sim.spawn(
             tx_inbound_loop(tx_in, responder.mempool, responder.tx_decode),
             label=f"{peer_id}.tx-in"))
+    return "accepted"
 
 
 async def _supervise_chain_sync(kernel: NodeKernel, session, candidate,

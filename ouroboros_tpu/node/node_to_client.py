@@ -64,7 +64,7 @@ def serve_node_to_client(kernel, mux_r: Mux, label: str = "local") -> list:
         res = await hs_proto.server_accept(hs, versions,
                                            policy=n2n.accept_same_magic)
         if res[0] != "accepted":
-            return
+            return "refused"
 
         blk_dec = kernel.block_decode_obj
         cs_codec = cs_proto.make_codec(blk_dec) if blk_dec \
@@ -110,8 +110,12 @@ def serve_node_to_client(kernel, mux_r: Mux, label: str = "local") -> list:
         threads.append(sim.spawn(
             ltx_proto.server(ltx_srv, try_add),
             label=f"{label}.local-ltx"))
+        return "accepted"
 
-    threads.append(sim.spawn(run(), label=f"{label}.local-accept"))
+    # threads[0] is the accept thread; awaiting it yields the handshake
+    # outcome ("accepted"/"refused") — diffusion's local server holds or
+    # releases the connection on it
+    threads.insert(0, sim.spawn(run(), label=f"{label}.local-accept"))
     kernel._threads.extend(threads)
     return threads
 

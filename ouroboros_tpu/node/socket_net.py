@@ -28,10 +28,11 @@ async def serve_node(kernel: NodeKernel, host: str = "127.0.0.1",
         mux = Mux(bearer, f"{peer_id}.mux")
         mux.start()
         try:
-            await _run_responder(kernel, mux, peer_id)
-            # keep the connection alive while the responder protocols run
-            while True:
-                await sim.sleep(3600.0)
+            outcome = await _run_responder(kernel, mux, peer_id)
+            if outcome != "refused":
+                # hold the fd while the responder protocols run; the
+                # demuxer's end (EOF/error) is the connection-down signal
+                await mux.wait_closed()
         finally:
             mux.stop()
             bearer.close()

@@ -1,0 +1,134 @@
+"""run_data_diffusion — the full composition root (VERDICT r3 next-step 6).
+
+Reference shape: Diffusion.hs:119-245 composes local node-to-client
+server + per-address accept servers + IP and DNS subscription workers +
+error policies from one DiffusionArguments record.  Tests here drive that
+record (a) fully in-sim over SimSnocket — two node addresses, a DNS-fed
+subscriber and a wallet client all through one diffusion each — and
+(b) as a real-socket smoke test over loopback TCP under the IO runtime.
+"""
+from ouroboros_tpu import simharness as sim
+from ouroboros_tpu.network.snocket import SimSnocket
+from ouroboros_tpu.network.subscription import DictResolver
+from ouroboros_tpu.node.diffusion import (
+    INITIATOR_ONLY, Diffusion, DiffusionArguments,
+    connect_local_client_via, run_data_diffusion,
+)
+from ouroboros_tpu.simharness import io_run
+from ouroboros_tpu.testing import PraosNetworkFactory, ThreadNetConfig
+
+
+def test_full_composition_in_sim():
+    """One diffusion record per node: node 0 listens on TWO addresses and
+    serves wallets on a local address; node 1 subscribes via IP producers;
+    node 2 subscribes via a DNS name resolving to both of node 0's
+    addresses.  A wallet connects through node 0's local server and
+    queries the tip — every box of Diffusion.hs:175-245 in one run."""
+    cfg = ThreadNetConfig(n_nodes=3, n_slots=30, k=10, f=0.5, seed=4)
+    factory = PraosNetworkFactory(cfg)
+
+    async def main():
+        snk = SimSnocket(delay=0.02)
+        local_snk = SimSnocket(delay=0.0)     # the unix-socket analog
+        resolver = DictResolver({"node0.example": (["addr0a", "addr0b"], [])})
+        kernels = [factory.make_node(i) for i in range(3)]
+        for k in kernels:
+            k.start()
+        d0 = await run_data_diffusion(
+            kernels[0],
+            DiffusionArguments(addresses=["addr0a", "addr0b"],
+                               local_address="wallet.sock",
+                               ip_producers=["addr1"], ip_valency=1),
+            snk, local_snocket=local_snk)
+        await run_data_diffusion(
+            kernels[1],
+            DiffusionArguments(addresses=["addr1"],
+                               ip_producers=["addr0a"], ip_valency=1),
+            snk)
+        await run_data_diffusion(
+            kernels[2],
+            DiffusionArguments(dns_producers=["node0.example"],
+                               dns_valency=2, mode=INITIATOR_ONLY),
+            snk, resolver=resolver)
+        await sim.sleep(30.0)
+
+        heights = [k.chain_db.current_chain.head_block_no for k in kernels]
+        # the wallet connects through the diffusion's local server
+        client = await connect_local_client_via(
+            local_snk, "wallet.sock",
+            (kernels[0].network_magic, kernels[0].block_decode_obj))
+        assert client is not None
+        tip = await client.query(["tip"])
+        assert isinstance(d0, Diffusion)
+        n_accepted = len(d0.tables["remote"])
+        for k in kernels:
+            k.stop()
+        return heights, tip, n_accepted
+
+    heights, tip, n_accepted = sim.run(main(), seed=4)
+    # all three nodes converge (node 2 is initiator-only via DNS)
+    assert min(heights) >= 5
+    assert max(heights) - min(heights) <= 3
+    assert tip is not None
+    # node 0's accept servers saw inbound connections
+    assert n_accepted >= 1
+
+
+def test_initiator_only_opens_no_listeners():
+    cfg = ThreadNetConfig(n_nodes=1, n_slots=5, k=10, f=0.5, seed=1)
+    factory = PraosNetworkFactory(cfg)
+
+    async def main():
+        snk = SimSnocket()
+        k = factory.make_node(0)
+        k.start()
+        d = await run_data_diffusion(
+            k, DiffusionArguments(addresses=["a0"], mode=INITIATOR_ONLY),
+            snk)
+        ok = len(d.listeners) == 0 and "a0" not in snk._listeners
+        k.stop()
+        return ok
+
+    assert sim.run(main())
+
+
+def test_diffusion_over_real_sockets():
+    """Smoke test: the same composition over loopback TCP under the IO
+    runtime — forger A serves two addresses, B reaches A through the
+    diffusion's subscription worker and syncs A's chain."""
+    from ouroboros_tpu.network.snocket import TcpSnocket
+
+    cfg = ThreadNetConfig(n_nodes=2, n_slots=20, slot_length=0.1, k=10,
+                          f=1.0, chain_sync_window=4)
+    factory = PraosNetworkFactory(cfg)
+
+    async def main():
+        snk = TcpSnocket()
+        a = factory.make_node(0)
+        b = factory.make_node(1)
+        b.forgings = []                    # B only syncs
+        a.start()
+        b.start()
+        da = await run_data_diffusion(
+            a, DiffusionArguments(addresses=[("127.0.0.1", 0)]), snk)
+        addr_a = da.listeners[0].addr      # resolved ephemeral port
+        await run_data_diffusion(
+            b, DiffusionArguments(ip_producers=[addr_a], ip_valency=1,
+                                  mode=INITIATOR_ONLY), snk)
+        await sim.sleep(cfg.n_slots * cfg.slot_length)
+        tip_a = a.chain_db.tip_point()
+        for _ in range(100):
+            if b.chain_db.contains_point(tip_a):
+                break
+            await sim.sleep(0.05)
+        out = (tip_a, b.chain_db.contains_point(tip_a),
+               a.chain_db.current_chain.head_block_no)
+        a.stop()
+        b.stop()
+        da.stop()
+        return out
+
+    tip_a, synced, head_a = io_run(main())
+    assert head_a >= 3, f"forger made no progress: {head_a}"
+    assert not tip_a.is_genesis
+    assert synced, "B did not sync A's tip through the diffusion"

@@ -19,8 +19,9 @@ from ouroboros_tpu.network.peer_selection import (
     ledger_peer_sample,
 )
 from ouroboros_tpu.network.subscription import SubscriptionWorker
+from ouroboros_tpu.network.snocket import SimSnocket
 from ouroboros_tpu.node.diffusion import (
-    DiffusionArguments, SimNetwork, run_data_diffusion,
+    DiffusionArguments, run_data_diffusion,
 )
 from ouroboros_tpu.testing import PraosNetworkFactory, ThreadNetConfig
 
@@ -187,19 +188,20 @@ def test_diffusion_joins_network_and_syncs():
     factory = PraosNetworkFactory(cfg)
 
     async def main():
-        net = SimNetwork(link_delay=0.02)
+        snk = SimSnocket(delay=0.02)
         kernels = [factory.make_node(i) for i in range(3)]
         for i, kern in enumerate(kernels):
             kern.start()
         # nodes 0,1 forge and interconnect via diffusion; node 2 has no
         # forging rights exercised (it still forges — fine) and subscribes
         # to both
-        run_data_diffusion(kernels[0], net, DiffusionArguments(
-            address="addr0", ip_targets=["addr1"], valency=1))
-        run_data_diffusion(kernels[1], net, DiffusionArguments(
-            address="addr1", ip_targets=["addr0"], valency=1))
-        run_data_diffusion(kernels[2], net, DiffusionArguments(
-            address="addr2", ip_targets=["addr0", "addr1"], valency=2))
+        await run_data_diffusion(kernels[0], DiffusionArguments(
+            addresses=["addr0"], ip_producers=["addr1"], ip_valency=1), snk)
+        await run_data_diffusion(kernels[1], DiffusionArguments(
+            addresses=["addr1"], ip_producers=["addr0"], ip_valency=1), snk)
+        await run_data_diffusion(kernels[2], DiffusionArguments(
+            addresses=["addr2"], ip_producers=["addr0", "addr1"],
+            ip_valency=2), snk)
         await sim.sleep(30.0)
         tips = [k.chain_db.tip_point() for k in kernels]
         heights = [k.chain_db.current_chain.head_block_no for k in kernels]
