@@ -154,7 +154,17 @@ class _Decoder:
             return [self.decode() for _ in range(self._arg(info))]
         if major == 5:
             n = self._arg(info)
-            return {self.decode(): self.decode() for _ in range(n)}
+            out = {}
+            for _ in range(n):
+                k = self.decode()
+                v = self.decode()
+                if isinstance(k, list):
+                    # array map keys (Shelley tx bodies use them) become
+                    # tuples so the dict stays usable; _encode re-emits
+                    # tuples as arrays, preserving round-trips
+                    k = _freeze(k)
+                out[k] = v
+            return out
         if major == 6:
             return Tag(self._arg(info), self.decode())
         # major 7
@@ -173,6 +183,13 @@ class _Decoder:
         if info == 27:
             return struct.unpack(">d", self._take(8))[0]
         raise CBORError(f"unsupported simple value {info}")
+
+
+def _freeze(obj):
+    """Recursively convert lists to tuples (for use as map keys)."""
+    if isinstance(obj, list):
+        return tuple(_freeze(x) for x in obj)
+    return obj
 
 
 def _decode_half(h: int) -> float:
