@@ -1,0 +1,620 @@
+"""Model-based (q-s-m) state-machine tests for the storage trio.
+
+Reference pattern: quickcheck-state-machine suites generating command
+sequences — including corruption and reopen — executed against both the
+real implementation and a pure model, with failing sequences shrunk to a
+minimal counterexample
+(`ouroboros-consensus-test/test-storage/Test/Ouroboros/Storage/
+{ImmutableDB,VolatileDB}/StateMachine.hs`, `.../LedgerDB/OnDisk.hs`;
+VERDICT r3 next-step 7).
+
+Engine: per seed, generate N commands; run them through the real DB
+(over MockFS) and the model, comparing every observation.  On mismatch,
+shrink by deleting command spans while the mismatch persists, then fail
+printing the minimal sequence.
+"""
+import hashlib
+import random
+
+import pytest
+
+from ouroboros_tpu.chain.block import Point
+from ouroboros_tpu.storage import ImmutableDB, LedgerDB, MockFS, VolatileDB
+from ouroboros_tpu.storage.immutabledb import _chunk_file, _secondary_file
+from ouroboros_tpu.storage.volatiledb import _file as _vol_file
+
+H = lambda i: hashlib.blake2b(b"qsm-%d" % i, digest_size=32).digest()
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+def run_qsm(suite_cls, seeds, n_cmds):
+    for seed in seeds:
+        rng = random.Random(seed)
+        cmds = suite_cls.generate(rng, n_cmds)
+        bad = _first_mismatch(suite_cls, cmds)
+        if bad is None:
+            continue
+        cmds = _shrink(suite_cls, cmds)
+        real_obs = suite_cls().run_real(cmds)
+        model_obs = suite_cls().run_model(cmds)
+        lines = [
+            f"seed {seed}: real/model diverge (shrunk to "
+            f"{len(cmds)} commands):"
+        ]
+        for c, r, m in zip(cmds, real_obs, model_obs):
+            mark = "  " if r == m else "->"
+            lines.append(f"{mark} {c!r}: real={r!r} model={m!r}")
+        pytest.fail("\n".join(lines))
+
+
+def _first_mismatch(suite_cls, cmds):
+    real = suite_cls().run_real(cmds)
+    model = suite_cls().run_model(cmds)
+    for i, (r, m) in enumerate(zip(real, model)):
+        if r != m:
+            return i
+    return None
+
+
+def _shrink(suite_cls, cmds):
+    """ddmin-style: repeatedly try removing spans, keeping the mismatch."""
+    span = max(1, len(cmds) // 2)
+    while span >= 1:
+        i = 0
+        while i < len(cmds):
+            candidate = cmds[:i] + cmds[i + span:]
+            if candidate and _first_mismatch(suite_cls, candidate) \
+                    is not None:
+                cmds = candidate
+            else:
+                i += span
+        span //= 2
+    return cmds
+
+
+# ---------------------------------------------------------------------------
+# ImmutableDB
+# ---------------------------------------------------------------------------
+
+CHUNK = 5          # small chunks: corruption + rotation exercised often
+
+
+class ImmSuite:
+    """Model: list of appended (slot, block_no, hash, data, is_ebb);
+    corruption commands drop the model's tail exactly as
+    Impl/Validation.hs-style recovery must."""
+
+    @staticmethod
+    def generate(rng, n):
+        cmds = []
+        slot = 0
+        for _ in range(n):
+            r = rng.random()
+            if r < 0.45:
+                is_ebb = rng.random() < 0.1
+                if not is_ebb:
+                    slot += rng.randint(0, 3)
+                cmds.append(("append", slot, rng.randint(0, 40),
+                             rng.randrange(1 << 30), is_ebb))
+                if not is_ebb:
+                    slot += 1
+            elif r < 0.55:
+                cmds.append(("append_bad", max(0, slot - rng.randint(1, 5)),
+                             rng.randrange(1 << 30)))
+            elif r < 0.65:
+                cmds.append(("get_slot", rng.randint(0, slot + 2)))
+            elif r < 0.72:
+                cmds.append(("tip",))
+            elif r < 0.79:
+                cmds.append(("stream", rng.randint(0, slot + 1),
+                             rng.randint(0, slot + 3)))
+            elif r < 0.87:
+                cmds.append(("reopen",))
+            elif r < 0.94:
+                cmds.append(("truncate_chunk_tail", rng.randint(1, 40)))
+            else:
+                cmds.append(("flip_last_block_byte",))
+        return cmds
+
+    def __init__(self):
+        self.fs = MockFS()
+        self.db = ImmutableDB.open(self.fs, chunk_size=CHUNK)
+        self.model = []        # [(slot, block_no, hash, data, is_ebb)]
+        self.disk_chunks = set()   # chunk files present on disk
+
+    # -- model helpers ------------------------------------------------------
+    def _model_chunks(self):
+        """chunk -> [(offset, size, idx_into_model)] mirroring file layout."""
+        chunks = {}
+        offsets = {}
+        for i, (slot, _bn, _h, data, _ebb) in enumerate(self.model):
+            n = slot // CHUNK
+            off = offsets.get(n, 0)
+            chunks.setdefault(n, []).append((off, len(data), i))
+            offsets[n] = off + len(data)
+        return chunks
+
+
+    def run_real(self, cmds):
+        obs = []
+        blocks = 0
+        for cmd in cmds:
+            op = cmd[0]
+            if op == "append":
+                _, slot, bn, nonce, is_ebb = cmd
+                data = b"blk-%d-%d" % (slot, nonce)
+                h = hashlib.blake2b(data, digest_size=32).digest()
+                try:
+                    self.db.append_block(slot, bn, h, b"\x00" * 32, data,
+                                         is_ebb=is_ebb)
+                    obs.append("ok")
+                except ValueError:
+                    obs.append("reject")
+            elif op == "append_bad":
+                _, slot, nonce = cmd
+                data = b"bad-%d" % nonce
+                h = hashlib.blake2b(data, digest_size=32).digest()
+                try:
+                    self.db.append_block(slot, 0, h, b"\x00" * 32, data)
+                    obs.append("ok")
+                except ValueError:
+                    obs.append("reject")
+            elif op == "get_slot":
+                got = self.db.get_by_slot(cmd[1])
+                obs.append(got)
+            elif op == "tip":
+                t = self.db.tip
+                obs.append(None if t is None else (t.slot, t.block_no))
+            elif op == "stream":
+                obs.append([d for _e, d in self.db.stream(cmd[1], cmd[2])])
+            elif op == "reopen":
+                self.db = ImmutableDB.open(self.fs, chunk_size=CHUNK)
+                obs.append(len(self.db))
+            elif op == "truncate_chunk_tail":
+                n = self._last_chunk_real()
+                if n is None:
+                    obs.append(None)
+                    continue
+                size = self.fs.file_size(_chunk_file(n))
+                self.fs.truncate_file(_chunk_file(n),
+                                      max(0, size - cmd[1]))
+                self.db = ImmutableDB.open(self.fs, chunk_size=CHUNK)
+                obs.append(len(self.db))
+            elif op == "flip_last_block_byte":
+                n = self._last_chunk_real()
+                if n is None:
+                    obs.append(None)
+                    continue
+                raw = self.fs.read_file(_chunk_file(n))
+                if not raw:
+                    obs.append("empty")
+                    continue
+                self.fs.write_file(
+                    _chunk_file(n),
+                    raw[:-1] + bytes([raw[-1] ^ 0xFF]))
+                self.db = ImmutableDB.open(self.fs, chunk_size=CHUNK)
+                obs.append(len(self.db))
+        return obs
+
+    def _last_chunk_real(self):
+        nos = [int(name.split(".")[0])
+               for name in self.fs.list_dir(("immutable",))
+               if name.endswith(".chunk")]
+        return max(nos) if nos else None
+
+    def run_model(self, cmds):
+        obs = []
+        for cmd in cmds:
+            op = cmd[0]
+            if op == "append":
+                _, slot, bn, nonce, is_ebb = cmd
+                data = b"blk-%d-%d" % (slot, nonce)
+                h = hashlib.blake2b(data, digest_size=32).digest()
+                if self._append_ok(slot, is_ebb):
+                    self.model.append((slot, bn, h, data, is_ebb))
+                    self.disk_chunks.add(slot // CHUNK)
+                    obs.append("ok")
+                else:
+                    obs.append("reject")
+            elif op == "append_bad":
+                _, slot, nonce = cmd
+                data = b"bad-%d" % nonce
+                h = hashlib.blake2b(data, digest_size=32).digest()
+                if self._append_ok(slot, False):
+                    self.model.append((slot, 0, h, data, False))
+                    self.disk_chunks.add(slot // CHUNK)
+                    obs.append("ok")
+                else:
+                    obs.append("reject")
+            elif op == "get_slot":
+                hit = None
+                for slot, _bn, _h, data, _ebb in self.model:
+                    if slot == cmd[1]:
+                        hit = data      # EBB + successor: real block wins
+                obs.append(hit)
+            elif op == "tip":
+                obs.append(None if not self.model
+                           else (self.model[-1][0], self.model[-1][1]))
+            elif op == "stream":
+                lo, hi = cmd[1], cmd[2]
+                obs.append([d for slot, _bn, _h, d, _e in self.model
+                            if lo <= slot <= hi])
+            elif op == "reopen":
+                obs.append(len(self.model))
+            elif op == "truncate_chunk_tail":
+                if not self.disk_chunks:
+                    obs.append(None)
+                    continue
+                chunks = self._model_chunks()
+                last = max(self.disk_chunks)
+                rows = chunks.get(last, [])
+                total = rows[-1][0] + rows[-1][1] if rows else 0
+                new_len = max(0, total - cmd[1])
+                # drop entries of the last chunk that no longer fit, and
+                # (validation truncates at the first bad entry) all after
+                cut = None
+                for off, sz, i in rows:
+                    if off + sz > new_len:
+                        cut = i
+                        break
+                if cut is not None:
+                    self.model = self.model[:cut]
+                    # past-corruption chunk files are removed on reopen
+                    self.disk_chunks = {c for c in self.disk_chunks
+                                        if c <= last}
+                obs.append(len(self.model))
+            elif op == "flip_last_block_byte":
+                if not self.disk_chunks:
+                    obs.append(None)
+                    continue
+                chunks = self._model_chunks()
+                last = max(self.disk_chunks)
+                rows = chunks.get(last, [])
+                if not rows:
+                    obs.append("empty")
+                    continue
+                # the flipped byte is the last byte of the chunk file ->
+                # the chunk's final block fails its CRC and is dropped
+                self.model = self.model[:rows[-1][2]]
+                self.disk_chunks = {c for c in self.disk_chunks
+                                    if c <= last}
+                obs.append(len(self.model))
+        return obs
+
+    def _append_ok(self, slot, is_ebb):
+        """Mirror of immutabledb._slot_ok: strictly increasing slots,
+        except a real block may share its predecessor EBB's slot."""
+        if not self.model:
+            return True
+        tslot, _, _, _, tebb = self.model[-1]
+        if slot > tslot:
+            return True
+        return slot == tslot and tebb and not is_ebb
+
+
+def test_immutabledb_state_machine():
+    run_qsm(ImmSuite, seeds=range(20), n_cmds=60)
+
+
+# ---------------------------------------------------------------------------
+# VolatileDB
+# ---------------------------------------------------------------------------
+
+VOL_PER_FILE = 3
+
+
+class VolSuite:
+    """Model: insertion-ordered dict hash -> (prev, slot, block_no, data)
+    plus file assignment by insertion order; GC drops whole files of
+    old-enough blocks; torn-tail truncation drops the last file's torn
+    records."""
+
+    @staticmethod
+    def generate(rng, n):
+        cmds = []
+        for _ in range(n):
+            r = rng.random()
+            if r < 0.4:
+                cmds.append(("put", rng.randint(0, 30), rng.randint(0, 30),
+                             rng.randint(0, 50), rng.randint(0, 40)))
+            elif r < 0.55:
+                cmds.append(("get", rng.randint(0, 30)))
+            elif r < 0.65:
+                cmds.append(("succ", rng.randint(0, 30)))
+            elif r < 0.72:
+                cmds.append(("len",))
+            elif r < 0.82:
+                cmds.append(("gc", rng.randint(0, 55)))
+            elif r < 0.92:
+                cmds.append(("reopen",))
+            else:
+                cmds.append(("truncate_tail", rng.randint(1, 30)))
+        return cmds
+
+    def __init__(self):
+        self.fs = MockFS()
+        self.db = VolatileDB.open(self.fs, max_blocks_per_file=VOL_PER_FILE)
+        self.model = {}        # hash -> (prev, slot, block_no, data)
+        # explicit disk/rotation state mirroring the implementation:
+        self.file_recs = {}    # file_no -> [hashes] physically in the file
+        self.disk_files = set()
+        self.cur_file = 0
+        self.cur_count = 0
+
+    def run_real(self, cmds):
+        obs = []
+        for cmd in cmds:
+            op = cmd[0]
+            if op == "put":
+                _, hi, pi, slot, nonce = cmd
+                data = b"v-%d-%d" % (hi, nonce)
+                self.db.put_block(H(hi), H(pi), slot, 0, data)
+                obs.append("ok")
+            elif op == "get":
+                obs.append(self.db.get_block(H(cmd[1])))
+            elif op == "succ":
+                obs.append(self.db.filter_by_predecessor(H(cmd[1])))
+            elif op == "len":
+                obs.append(len(self.db))
+            elif op == "gc":
+                self.db.garbage_collect(cmd[1])
+                obs.append(len(self.db))
+            elif op == "reopen":
+                self.db = VolatileDB.open(self.fs,
+                                          max_blocks_per_file=VOL_PER_FILE)
+                obs.append(len(self.db))
+            elif op == "truncate_tail":
+                n = self._last_file_real()
+                if n is None:
+                    obs.append(None)
+                    continue
+                size = self.fs.file_size(_vol_file(n))
+                self.fs.truncate_file(_vol_file(n), max(0, size - cmd[1]))
+                self.db = VolatileDB.open(self.fs,
+                                          max_blocks_per_file=VOL_PER_FILE)
+                obs.append(len(self.db))
+        return obs
+
+    def _last_file_real(self):
+        nos = [int(name.split("-")[1].split(".")[0])
+               for name in self.fs.list_dir(("volatile",))
+               if name.startswith("vol-")]
+        return max(nos) if nos else None
+
+    def run_model(self, cmds):
+        obs = []
+        for cmd in cmds:
+            op = cmd[0]
+            if op == "put":
+                _, hi, pi, slot, nonce = cmd
+                h = H(hi)
+                if h not in self.model:
+                    self.model[h] = (H(pi), slot, 0,
+                                     b"v-%d-%d" % (hi, nonce))
+                    self.file_recs.setdefault(self.cur_file, []).append(h)
+                    self.disk_files.add(self.cur_file)
+                    self.cur_count += 1
+                    if self.cur_count >= VOL_PER_FILE:
+                        self.cur_file += 1
+                        self.cur_count = 0
+                obs.append("ok")
+            elif op == "get":
+                e = self.model.get(H(cmd[1]))
+                obs.append(None if e is None else e[3])
+            elif op == "succ":
+                p = H(cmd[1])
+                obs.append(frozenset(h for h, e in self.model.items()
+                                     if e[0] == p))
+            elif op == "len":
+                obs.append(len(self.model))
+            elif op == "gc":
+                for fn in sorted(self.disk_files):
+                    if fn == self.cur_file:
+                        continue
+                    hashes = self.file_recs.get(fn, [])
+                    if hashes and all(self.model[h][1] < cmd[1]
+                                      for h in hashes):
+                        for h in hashes:
+                            del self.model[h]
+                        del self.file_recs[fn]
+                        self.disk_files.discard(fn)
+                obs.append(len(self.model))
+            elif op == "reopen":
+                # current file/count recomputed from the disk listing
+                if self.disk_files:
+                    last = max(self.disk_files)
+                    self.cur_file = last
+                    self.cur_count = len(self.file_recs.get(last, []))
+                    if self.cur_count >= VOL_PER_FILE:
+                        self.cur_file += 1
+                        self.cur_count = 0
+                else:
+                    self.cur_file, self.cur_count = 0, 0
+                obs.append(len(self.model))
+            elif op == "truncate_tail":
+                if not self.disk_files:
+                    obs.append(None)
+                    continue
+                last = max(self.disk_files)
+                recs = self.file_recs.get(last, [])
+                # record layout: header CBOR + data per record; a cut of k
+                # bytes drops every record whose end lies past the new
+                # length (parsing stops at the first torn record)
+                from ouroboros_tpu.storage.fs import crc32
+                from ouroboros_tpu.utils import cbor as C
+                pos = 0
+                ends = []
+                for h in recs:
+                    prev, slot, bn, data = self.model[h]
+                    header = C.dumps([h, prev, slot, bn, crc32(data),
+                                      len(data)])
+                    pos += len(header) + len(data)
+                    ends.append((h, pos))
+                new_len = max(0, pos - cmd[1])
+                cut_from = None
+                for i, (h, end) in enumerate(ends):
+                    if end > new_len:
+                        cut_from = i
+                        break
+                if cut_from is not None:
+                    for h, _end in ends[cut_from:]:
+                        del self.model[h]
+                    self.file_recs[last] = recs[:cut_from]
+                # reopen recomputes rotation state
+                self.cur_file = last
+                self.cur_count = len(self.file_recs.get(last, []))
+                if self.cur_count >= VOL_PER_FILE:
+                    self.cur_file += 1
+                    self.cur_count = 0
+                obs.append(len(self.model))
+        return obs
+
+
+def test_volatiledb_state_machine():
+    run_qsm(VolSuite, seeds=range(20), n_cmds=60)
+
+
+# ---------------------------------------------------------------------------
+# LedgerDB (in-memory ops + on-disk snapshots)
+# ---------------------------------------------------------------------------
+
+K = 4
+
+
+class LgrSuite:
+    """Model: plain list of (point, state) bounded to K with an anchor;
+    snapshot/restore round-trips through MockFS incl. corrupt-snapshot
+    fallback."""
+
+    @staticmethod
+    def generate(rng, n):
+        cmds = []
+        slot = 0
+        for _ in range(n):
+            r = rng.random()
+            if r < 0.35:
+                slot += rng.randint(1, 3)
+                cmds.append(("push", slot, rng.randrange(1 << 20)))
+            elif r < 0.5:
+                cmds.append(("rollback", rng.randint(0, K + 1)))
+            elif r < 0.6:
+                cmds.append(("state_at", rng.randint(0, max(slot, 1))))
+            elif r < 0.7:
+                cmds.append(("tip",))
+            elif r < 0.78:
+                cmds.append(("prune", rng.randint(0, slot + 2)))
+            elif r < 0.86:
+                cmds.append(("snapshot", slot))
+            elif r < 0.93:
+                cmds.append(("restore",))
+            else:
+                cmds.append(("corrupt_latest_snapshot",))
+        return cmds
+
+    def __init__(self):
+        self.fs = MockFS()
+        anchor = Point.genesis()
+        self.db = LedgerDB(K, anchor, 0)
+        self.m_anchor = (anchor, 0)
+        self.m_states = []     # [(Point, state)]
+
+    @staticmethod
+    def _pt(slot, val):
+        return Point(slot, hashlib.blake2b(b"p%d-%d" % (slot, val),
+                                           digest_size=32).digest())
+
+    def run_real(self, cmds):
+        obs = []
+        for cmd in cmds:
+            op = cmd[0]
+            if op == "push":
+                self.db.push(self._pt(cmd[1], cmd[2]), cmd[2])
+                obs.append("ok")
+            elif op == "rollback":
+                obs.append(self.db.rollback(cmd[1]))
+            elif op == "state_at":
+                pts = self.db.past_points()
+                hit = [self.db.state_at(p) for p in pts
+                       if p.slot == cmd[1]]
+                obs.append(hit)
+            elif op == "tip":
+                obs.append((self.db.tip_point, self.db.current,
+                            len(self.db)))
+            elif op == "prune":
+                self.db.prune_to_slot(cmd[1])
+                obs.append((self.db.anchor_point.slot
+                            if not self.db.anchor_point.is_genesis else -1,
+                            len(self.db)))
+            elif op == "snapshot":
+                LedgerDB.take_snapshot(self.fs, cmd[1], self.db.tip_point,
+                                       self.db.current, lambda s: s)
+                obs.append("ok")
+            elif op == "restore":
+                got = LedgerDB.read_latest_snapshot(self.fs, lambda s: s)
+                obs.append(got if got is None else (got[0], got[2]))
+            elif op == "corrupt_latest_snapshot":
+                snaps = sorted((n for n in self.fs.list_dir(("ledger",))
+                                if n.startswith("snap-")), reverse=True)
+                if snaps:
+                    self.fs.write_file(("ledger", snaps[0]), b"\xff\x00")
+                obs.append(len(snaps))
+        return obs
+
+    def run_model(self, cmds):
+        obs = []
+        snaps = {}             # slot -> (tip_slot_or_None, state) or "bad"
+        for cmd in cmds:
+            op = cmd[0]
+            if op == "push":
+                self.m_states.append((self._pt(cmd[1], cmd[2]), cmd[2]))
+                if len(self.m_states) > K:
+                    self.m_anchor = self.m_states[0]
+                    del self.m_states[0]
+                obs.append("ok")
+            elif op == "rollback":
+                n = cmd[1]
+                if n > len(self.m_states):
+                    obs.append(False)
+                else:
+                    if n:
+                        del self.m_states[-n:]
+                    obs.append(True)
+            elif op == "state_at":
+                pts = [self.m_anchor] + self.m_states
+                obs.append([s for p, s in pts if p.slot == cmd[1]])
+            elif op == "tip":
+                p, s = (self.m_states[-1] if self.m_states
+                        else self.m_anchor)
+                obs.append((p, s, len(self.m_states)))
+            elif op == "prune":
+                while self.m_anchor[0].slot < cmd[1] and self.m_states:
+                    self.m_anchor = self.m_states[0]
+                    del self.m_states[0]
+                obs.append((self.m_anchor[0].slot
+                            if not self.m_anchor[0].is_genesis else -1,
+                            len(self.m_states)))
+            elif op == "snapshot":
+                p, s = (self.m_states[-1] if self.m_states
+                        else self.m_anchor)
+                snaps[cmd[1]] = s
+                # trim to DiskPolicy.num_snapshots (2) newest
+                for old in sorted(snaps)[:-2]:
+                    del snaps[old]
+                obs.append("ok")
+            elif op == "restore":
+                good = [sl for sl in sorted(snaps, reverse=True)
+                        if snaps[sl] != "bad"]
+                obs.append(None if not good
+                           else (good[0], snaps[good[0]]))
+            elif op == "corrupt_latest_snapshot":
+                if snaps:
+                    snaps[max(snaps)] = "bad"
+                obs.append(len(snaps))
+        return obs
+
+
+def test_ledgerdb_state_machine():
+    run_qsm(LgrSuite, seeds=range(25), n_cmds=50)
