@@ -48,11 +48,16 @@ class FetchBudget:
     max_in_flight_bytes_per_peer: int = 256 * 1024
     max_concurrent_peers: int = 4
     max_request_expected_secs: float = 5.0
+    # deadline-mode duplicate-fetch race (Decision.hs deadline semantics):
+    # a block already in flight with a slow peer may be re-requested from
+    # a peer whose DeltaQ arrival estimate beats the claimant's by this
+    # factor; 0 disables racing (bulk sync never duplicates)
+    duplicate_speedup: float = 0.0
 
     @classmethod
     def bulk_sync(cls) -> "FetchBudget":
         """FetchModeBulkSync: far from the tip — few peers, big batches
-        (maximise throughput; losing a duplicate-fetch race is cheap)."""
+        (maximise throughput; duplicate fetches are pure waste here)."""
         return cls(max_blocks_per_request=32,
                    max_in_flight_bytes_per_peer=512 * 1024,
                    max_concurrent_peers=2,
@@ -62,11 +67,13 @@ class FetchBudget:
     def deadline(cls) -> "FetchBudget":
         """FetchModeDeadline: near the tip — more peers, small requests,
         tight expected-duration bound (minimise time-to-adoption; the
-        block-diffusion deadline of BASELINE.md)."""
+        block-diffusion deadline of BASELINE.md), and duplicate racing
+        against clearly-slower in-flight claims."""
         return cls(max_blocks_per_request=4,
                    max_in_flight_bytes_per_peer=128 * 1024,
                    max_concurrent_peers=8,
-                   max_request_expected_secs=2.0)
+                   max_request_expected_secs=2.0,
+                   duplicate_speedup=2.0)
 
 
 class PeerFetchState:
@@ -127,13 +134,23 @@ def fetch_decisions(
         from dataclasses import replace as _replace
         budget = _replace(budget,
                           max_blocks_per_request=max_blocks_per_request)
-    claimed: set[bytes] = set()
+    # claimed: hash -> the claiming peer's DeltaQ arrival estimate (inf
+    # when unknown).  Deadline mode races a clearly-faster peer against a
+    # slow claim; bulk mode treats every claim as final.
+    claimed: Dict[bytes, float] = {}
     busy_count = 0
-    for ps in peer_states.values():
-        claimed |= ps.in_flight
+    for peer, ps in peer_states.items():
+        tracker = gsv(peer) if gsv is not None else None
+        eta = (tracker.expected_fetch_time(
+            max(ps.in_flight_bytes, ps.avg_block_bytes))
+            if tracker is not None else float("inf"))
+        for h in ps.in_flight:
+            claimed[h] = min(claimed.get(h, float("inf")), eta)
         queued = _queued(ps.queue)
         for req in queued:
-            claimed |= {h.hash for h in req.headers}
+            for h in req.headers:
+                claimed[h.hash] = min(claimed.get(h.hash, float("inf")),
+                                      eta)
         if ps.busy or queued:
             busy_count += 1
 
@@ -191,12 +208,22 @@ def fetch_decisions(
                 ps.done_through = None
         if blocks is None:
             blocks = frag.blocks
+        my_eta = (tracker.expected_fetch_time(est)
+                  if tracker is not None else float("inf"))
         run: list = []
         start: Optional[Point] = None
         frontier_ok = True               # still in the contiguous stored prefix
         for h in blocks:
             stored = have_block(h.hash)
-            needed = not stored and h.hash not in claimed
+            other_eta = claimed.get(h.hash)
+            needed = not stored and (
+                other_eta is None
+                # the deadline-mode duplicate race: fetch a claimed block
+                # again iff our arrival beats the claim by the configured
+                # factor (Decision.hs deadline-mode in-flight-with-other-
+                # peers filtering)
+                or (budget.duplicate_speedup > 0
+                    and my_eta * budget.duplicate_speedup < other_eta))
             if needed:
                 if not run:
                     start = prev_point
@@ -218,7 +245,9 @@ def fetch_decisions(
         if run:
             req = FetchRequest(peer, start, tuple(run),
                                est_bytes=len(run) * est)
-            claimed |= {h.hash for h in run}
+            for h in run:
+                claimed[h.hash] = min(claimed.get(h.hash, float("inf")),
+                                      my_eta)
             decisions.append(req)
             busy_count += 1
     return decisions

@@ -81,6 +81,91 @@ class TestFetchModes:
         assert all(r.peer_id == "fast" for r in reqs)
 
 
+    def test_decision_flips_on_gsv_change_alone(self):
+        """Same candidates, same in-flight state, same everything except
+        one peer's GSV estimate: the request target flips (VERDICT r3
+        next-step 9 'decision flips on a GSV change alone')."""
+        hs = _chain(4)
+        frag = _frag(hs)
+
+        class _T:
+            def __init__(self, g, s):
+                self.g, self.s = g, s
+
+            def expected_fetch_time(self, nbytes):
+                return 2 * self.g + self.s * nbytes
+
+        def decide(g_a, g_b):
+            peers = {"a": PeerFetchState("a"), "b": PeerFetchState("b")}
+            gsvs = {"a": _T(g_a, 1e-7), "b": _T(g_b, 1e-7)}
+            reqs = fetch_decisions(
+                {"a": frag, "b": frag}, peers,
+                lambda f: True, lambda h: False,
+                order_key=lambda p: gsvs[p].expected_fetch_time(4096),
+                budget=FetchBudget.deadline(), gsv=gsvs.get)
+            assert reqs
+            return reqs[0].peer_id
+
+        assert decide(0.01, 0.3) == "a"
+        assert decide(0.3, 0.01) == "b"   # ONLY the GSVs swapped
+
+    def test_deadline_mode_races_slow_in_flight_claim(self):
+        """A block in flight with a slow peer is re-requested by a much
+        faster newcomer in deadline mode (duplicate race), but never in
+        bulk-sync mode (Decision.hs FetchMode semantics)."""
+        hs = _chain(2)
+        frag = _frag(hs)
+
+        class _T:
+            def __init__(self, eta):
+                self.eta = eta
+
+            def expected_fetch_time(self, nbytes):
+                return self.eta
+
+        slow = PeerFetchState("slow")
+        slow.in_flight = {h.hash for h in hs}
+        slow.in_flight_bytes = 4096
+        fast = PeerFetchState("fast")
+        gsvs = {"slow": _T(30.0), "fast": _T(0.05)}
+
+        def decide(budget):
+            return fetch_decisions(
+                {"fast": frag}, {"slow": slow, "fast": fast},
+                lambda f: True, lambda h: False,
+                order_key=lambda p: gsvs[p].expected_fetch_time(4096),
+                budget=budget, gsv=gsvs.get)
+
+        raced = decide(FetchBudget.deadline())
+        assert raced and raced[0].peer_id == "fast"
+        assert {h.hash for h in raced[0].headers} == slow.in_flight
+        assert decide(FetchBudget.bulk_sync()) == []
+
+    def test_no_race_when_claimant_is_fast_enough(self):
+        """The duplicate race needs a clear win: a modestly slower claim
+        is NOT re-fetched (duplicate downloads are not free)."""
+        hs = _chain(2)
+        frag = _frag(hs)
+
+        class _T:
+            def __init__(self, eta):
+                self.eta = eta
+
+            def expected_fetch_time(self, nbytes):
+                return self.eta
+
+        claimant = PeerFetchState("claimant")
+        claimant.in_flight = {h.hash for h in hs}
+        other = PeerFetchState("other")
+        gsvs = {"claimant": _T(0.4), "other": _T(0.3)}   # only 1.3x faster
+        reqs = fetch_decisions(
+            {"other": frag}, {"claimant": claimant, "other": other},
+            lambda f: True, lambda h: False,
+            order_key=lambda p: gsvs[p].expected_fetch_time(4096),
+            budget=FetchBudget.deadline(), gsv=gsvs.get)
+        assert reqs == []
+
+
 class TestWatermarkPipelining:
     def test_low_high_mark_policy(self):
         """pipelineDecisionLowHighMark: fill to the high mark while
@@ -149,3 +234,25 @@ class _ServerSession:
 
     async def recv(self):
         return await self.channel.recv()
+
+def test_queued_requests_claim_blocks_too():
+    """A FetchRequest sitting in a peer's queue (not yet in flight)
+    claims its blocks: bulk-sync mode never hands them to another peer
+    (regression: queued claims were keyed by header object, not hash)."""
+    from ouroboros_tpu import simharness as sim
+    from ouroboros_tpu.node.block_fetch import FetchRequest
+
+    hs = _chain(4)
+    frag = _frag(hs)
+
+    async def main():
+        a = PeerFetchState("a")
+        b = PeerFetchState("b")
+        req = FetchRequest("a", frag.anchor, tuple(hs))
+        await sim.atomically(lambda tx: a.queue.put(tx, req))
+        return fetch_decisions(
+            {"b": frag}, {"a": a, "b": b},
+            lambda f: True, lambda h: False,
+            budget=FetchBudget.bulk_sync())
+
+    assert sim.run(main()) == []
