@@ -71,18 +71,40 @@ class HardForkLedgerView:
     summary: Summary
 
 
-def _summary(eras: Sequence[Era], state: HardForkState,
-             inner_ledger_state: Optional[Any] = None) -> Summary:
-    """Summary from recorded transitions plus (if decided) the current
-    era's pending transition read from the inner ledger state."""
-    transitions = list(state.transitions)
+# Summary construction is pure in (era params, transition epochs), and the
+# transition tuple only changes when a transition is decided or crossed —
+# so summaries are memoised per transition tuple (the History/Caching.hs
+# EpochInfo cache role).  Keyed on the era-params identity so distinct
+# ledgers don't share entries.
+_SUMMARY_CACHE: dict = {}
+_SUMMARY_CACHE_MAX = 256
+
+
+def _effective_transitions(eras: Sequence[Era], state: HardForkState,
+                           inner_ledger_state: Optional[Any]) -> tuple:
+    """Recorded transitions plus (if decided) the current era's pending
+    transition read from the inner ledger state."""
+    transitions = tuple(state.transitions)
     if inner_ledger_state is not None and state.era < len(eras) - 1:
         fn = eras[state.era].transition_epoch
         pending = fn(inner_ledger_state) if fn is not None else None
         if pending is not None:
-            transitions = transitions + [pending]
-    params = [e.params for e in eras[:len(transitions) + 1]]
-    return Summary.from_era_params(params, transitions)
+            transitions = transitions + (pending,)
+    return transitions
+
+
+def _summary(eras: Sequence[Era], state: HardForkState,
+             inner_ledger_state: Optional[Any] = None) -> Summary:
+    transitions = _effective_transitions(eras, state, inner_ledger_state)
+    key = (tuple(e.params for e in eras), transitions)   # frozen dataclass
+    s = _SUMMARY_CACHE.get(key)
+    if s is None:
+        params = [e.params for e in eras[:len(transitions) + 1]]
+        s = Summary.from_era_params(params, list(transitions))
+        if len(_SUMMARY_CACHE) >= _SUMMARY_CACHE_MAX:
+            _SUMMARY_CACHE.clear()
+        _SUMMARY_CACHE[key] = s
+    return s
 
 
 def era_of_slot(eras: Sequence[Era], state: HardForkState,
