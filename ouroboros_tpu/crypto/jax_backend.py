@@ -202,17 +202,18 @@ class JaxBackend(CryptoBackend):
                                              jnp.asarray(signG))
         return vrf_jax._finish_betas(np.asarray(rows), decode_ok, n)
 
-    def _window_composite(self, ne: int, nv: int, nb: int, pallas: bool):
+    def _window_composite(self, ne: int, nv: int, nb: int, flags: tuple):
         """One jitted device program for a whole window: Ed25519 verify +
         VRF verify + next-window gamma8 betas, results concatenated into
         the packed flat uint8 buffer on device.  ONE launch per window —
         separate dispatches each pay the accelerator tunnel's fixed launch
         latency (~150-200 ms), which dominated the replay.
 
-        Both kernel families compile to the same packed layout, so the
-        autotuner can time them on identical args and finish_window never
-        needs to know which one ran."""
-        key = (ne, nv, nb, pallas)
+        flags = (ed_pallas, vrf_pallas, beta_pallas): each part uses the
+        kernel family the per-component autotune chose.  Only the winning
+        combination is ever compiled — compiling BOTH full composites at
+        replay shapes cost upwards of an hour of XLA time."""
+        key = (ne, nv, nb, flags)
         fn = self._composites.get(key)
         if fn is not None:
             return fn
@@ -221,11 +222,12 @@ class JaxBackend(CryptoBackend):
 
         from . import vrf_jax
         PK = getattr(self, "_pk", None)
+        ed_p, vrf_p, beta_p = flags
 
         def call(ed_args, vrf_args, beta_args):
             parts = []
             if ed_args is not None:
-                if pallas:
+                if ed_p:
                     ok = PK._ed25519_verify_call(*ed_args, ne)
                 else:
                     yA, signA2, yR, signR2, s_bits, k_bits = ed_args
@@ -233,7 +235,7 @@ class JaxBackend(CryptoBackend):
                                              s_bits, k_bits)
                 parts.append(ok.reshape(-1).astype(jnp.uint8))
             if vrf_args is not None:
-                if pallas:
+                if vrf_p:
                     rows = PK._vrf_verify_call(*vrf_args, nv)
                 else:
                     yY, sY2, yG, sG2, r, cb, lob, hib = vrf_args
@@ -241,7 +243,7 @@ class JaxBackend(CryptoBackend):
                                                    r, cb, lob, hib)
                 parts.append(rows.reshape(-1))
             if beta_args is not None:
-                if pallas:
+                if beta_p:
                     rows = PK._gamma8_call(*beta_args, nb)
                 else:
                     byG, bsG2 = beta_args
@@ -310,15 +312,35 @@ class JaxBackend(CryptoBackend):
         if ed_args is None and vrf_args is None and beta_args is None:
             packed = None
         else:
-            use, packed = self._pick(
-                ("win", ne, nv, nb),
-                lambda: np.asarray(self._window_composite(ne, nv, nb, True)(
-                    ed_args, vrf_args, beta_args)),
-                lambda: np.asarray(self._window_composite(ne, nv, nb, False)(
-                    ed_args, vrf_args, beta_args)))
-            if packed is None:
-                packed = self._window_composite(ne, nv, nb, use)(
-                    ed_args, vrf_args, beta_args)
+            # per-component autotune (keys shared with the simple-batch
+            # paths), then ONE fused composite for the winning combination
+            use_ed = use_vrf = use_beta = False
+            if ed_args is not None:
+                use_ed, _ = self._pick(
+                    ("ed", ne),
+                    lambda: np.asarray(self._pk._ed25519_verify_jit(
+                        *ed_args, ne)),
+                    lambda: np.asarray(EJ.verify_full_kernel(
+                        ed_args[0], ed_args[1][0], ed_args[2],
+                        ed_args[3][0], ed_args[4], ed_args[5])))
+            if vrf_args is not None:
+                use_vrf, _ = self._pick(
+                    ("vrf", nv),
+                    lambda: np.asarray(self._pk._vrf_verify_jit(
+                        *vrf_args, nv)),
+                    lambda: np.asarray(vrf_jax.vrf_verify_kernel(
+                        vrf_args[0], vrf_args[1][0], vrf_args[2],
+                        vrf_args[3][0], *vrf_args[4:])))
+            if beta_args is not None:
+                use_beta, _ = self._pick(
+                    ("beta", nb),
+                    lambda: np.asarray(self._pk._gamma8_jit(
+                        *beta_args, nb)),
+                    lambda: np.asarray(vrf_jax.gamma8_kernel(
+                        beta_args[0], beta_args[1][0])))
+            packed = self._window_composite(
+                ne, nv, nb, (use_ed, use_vrf, use_beta))(
+                ed_args, vrf_args, beta_args)
         return {"packed": packed, "n": n,
                 "ed": ed_state, "ed_owner": ed_owner, "ne": ne,
                 "vrf": vrf_state, "vrf_owner": vrf_owner,
