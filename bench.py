@@ -320,6 +320,9 @@ def main():
             "breakdown": {
                 "device_secs": round(dev_secs, 3),
                 "host_secs": round(tpu_secs - dev_secs, 3)},
+            "kernel_choices": {
+                "@".join(str(p) for p in k): ("pallas" if v else "xla")
+                for k, v in getattr(jb._inner, "_choice", {}).items()},
             "primitives": prim,
         }))
     finally:
