@@ -53,8 +53,7 @@ class JaxBackend(CryptoBackend):
     def __init__(self, min_bucket: int = 128, use_pallas: bool | None = None,
                  autotune: bool | None = None):
         import jax  # fail here if jax unusable -> default_backend falls back
-        from .pallas_kernels import _ensure_compile_cache
-        _ensure_compile_cache()   # ladder compiles are minutes; cache them
+        EJ._ensure_compile_cache()   # ladder compiles are minutes; cache
         self._devices = jax.devices()
         on_tpu = self._devices[0].platform == "tpu"
         if autotune is None:

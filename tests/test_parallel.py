@@ -83,8 +83,10 @@ def test_sharded_backend_mixed_window_parity():
 
 
 def test_sharded_ed25519_thousands_of_proofs():
-    """Scale check: 4096 signatures over the 8-device mesh, all accepted,
-    one tampered entry localized correctly."""
+    """Scale check: 1024 signatures over the 8-device mesh (128 ladders
+    per virtual device), all accepted, one tampered entry localized
+    correctly.  4096 took 4.5 min of pure XLA:CPU ladder runtime for no
+    extra coverage."""
     import hashlib
 
     from ouroboros_tpu.crypto import ed25519_ref
@@ -97,12 +99,12 @@ def test_sharded_ed25519_thousands_of_proofs():
     sk = hashlib.sha256(b"shard-scale").digest()
     key = Ed25519PrivateKey.from_private_bytes(sk)
     vk = ed25519_ref.public_key(sk)
-    n = 4096
+    n = 1024
     msgs = [b"blk-%05d" % i for i in range(n)]
     sigs = [key.sign(m) for m in msgs]
-    sigs[2049] = sigs[2049][:20] + b"\x00" + sigs[2049][21:]
+    sigs[513] = sigs[513][:20] + b"\x00" + sigs[513][21:]
     got = sharded_batch_verify([vk] * n, msgs, sigs, mesh)
-    assert got == [i != 2049 for i in range(n)]
+    assert got == [i != 513 for i in range(n)]
 
 
 def test_sharded_submit_window_pipelines():
