@@ -43,12 +43,12 @@ def _ensure_compile_cache() -> None:
     import os
     import tempfile
     try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         if jax.config.jax_compilation_cache_dir is not None:
-            return              # an application already configured a cache
+            return              # an application already configured a dir
         d = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
             tempfile.gettempdir(), "jax-ouro-cache")
         jax.config.update("jax_compilation_cache_dir", d)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     except Exception:
         pass
 

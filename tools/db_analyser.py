@@ -262,6 +262,17 @@ def analyse_real_shelley(path: str, backend_name: str, out) -> None:
     a0 = hashlib.blake2b(b"\x00", digest_size=32).digest()
     a1 = hashlib.blake2b(b"\x01", digest_size=32).digest()
     try:
+        tx = SC.parse_tx(raw)
+    except (ValueError, IndexError, TypeError, KeyError):
+        tx = None
+    if tx is not None:
+        ok = SC.validate_tx(tx, backend)
+        print(f"shelley tx: txid {tx.body_hash.hex()} "
+              f"witnesses {len(tx.witnesses)}; "
+              f"witness crypto [{backend.name}]: "
+              f"{'ok' if ok else 'FAILED'}", file=out)
+        return
+    try:
         blk = SC.parse_block(raw)
     except ValueError:
         blk = None
