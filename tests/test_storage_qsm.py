@@ -296,7 +296,7 @@ class ImmSuite:
 
 
 def test_immutabledb_state_machine():
-    run_qsm(ImmSuite, seeds=range(20), n_cmds=60)
+    run_qsm(ImmSuite, seeds=range(200), n_cmds=60)
 
 
 # ---------------------------------------------------------------------------
@@ -474,7 +474,7 @@ class VolSuite:
 
 
 def test_volatiledb_state_machine():
-    run_qsm(VolSuite, seeds=range(20), n_cmds=60)
+    run_qsm(VolSuite, seeds=range(200), n_cmds=60)
 
 
 # ---------------------------------------------------------------------------
@@ -617,4 +617,4 @@ class LgrSuite:
 
 
 def test_ledgerdb_state_machine():
-    run_qsm(LgrSuite, seeds=range(25), n_cmds=50)
+    run_qsm(LgrSuite, seeds=range(250), n_cmds=50)
