@@ -1,0 +1,189 @@
+"""Batched BLAKE2b-256 on device — the KES hash-path kernel.
+
+Reference seam: Sum6KES(Ed25519, Blake2b_256) in
+Shelley/Protocol/Crypto.hs:15-23 — verifying one KES signature checks a
+depth-long chain of Blake2b-256 hashes over 64-byte (vk_L || vk_R) pairs
+plus one Ed25519 leaf verify.  VERDICT r4 missing #2: that hash path ran
+per-item in host Python (crypto/kes.py); here it is one data-parallel
+device program over every (level, signature) pair of a window.
+
+Representation: 64-bit words as uint32 (lo, hi) pairs on the sublane
+axis, batch on lanes — adds carry via an unsigned compare, rotations are
+shift pairs.  Every message here is exactly 64 bytes (one final block),
+so the compression function runs once per item: 12 rounds x 8 G
+mixes ≈ 4k VPU ops/item — negligible next to the curve ladders it shares
+a fused window program with.
+
+Oracle: hashlib.blake2b(digest_size=32) — tests/test_crypto_jax.py pins
+bit-exactness on random vectors.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# BLAKE2b IV (64-bit words)
+_IV = (
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B,
+    0x3C6EF372FE94F82B, 0xA54FF53A5F1D36F1,
+    0x510E527FADE682D1, 0x9B05688C2B3E6C1F,
+    0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+)
+
+_SIGMA = (
+    (0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15),
+    (14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3),
+    (11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4),
+    (7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8),
+    (9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13),
+    (2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9),
+    (12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11),
+    (13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10),
+    (6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5),
+    (10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0),
+)
+_ROUNDS = tuple(_SIGMA[r % 10] for r in range(12))
+
+# h0 with parameter block for digest_size=32, no key, fanout=depth=1
+_H0 = (_IV[0] ^ 0x01010020,) + _IV[1:]
+
+
+def _add64(a, b):
+    lo = a[0] + b[0]
+    carry = (lo < a[0]).astype(jnp.uint32)
+    return lo, a[1] + b[1] + carry
+
+
+def _xor64(a, b):
+    return a[0] ^ b[0], a[1] ^ b[1]
+
+
+def _rotr64(a, r: int):
+    lo, hi = a
+    if r == 32:
+        return hi, lo
+    if r < 32:
+        return ((lo >> r) | (hi << (32 - r)),
+                (hi >> r) | (lo << (32 - r)))
+    s = r - 32     # rotr by 32 then by s
+    return ((hi >> s) | (lo << (32 - s)),
+            (lo >> s) | (hi << (32 - s)))
+
+
+def _c64(x: int, ref):
+    """64-bit constant as a (lo, hi) pair broadcast to ref's lane shape."""
+    z = ref * 0
+    return (z + np.uint32(x & 0xFFFFFFFF), z + np.uint32(x >> 32))
+
+
+def _g(v, a, b, c, d, mx, my):
+    v[a] = _add64(_add64(v[a], v[b]), mx)
+    v[d] = _rotr64(_xor64(v[d], v[a]), 32)
+    v[c] = _add64(v[c], v[d])
+    v[b] = _rotr64(_xor64(v[b], v[c]), 24)
+    v[a] = _add64(_add64(v[a], v[b]), my)
+    v[d] = _rotr64(_xor64(v[d], v[a]), 16)
+    v[c] = _add64(v[c], v[d])
+    v[b] = _rotr64(_xor64(v[b], v[c]), 63)
+
+
+_SIGMA_ARR = np.array(_ROUNDS, dtype=np.int32)   # (12, 16)
+
+
+def compress_block64(m_words, unroll: bool = False):
+    """One final-block BLAKE2b-256 compression over 64-byte messages.
+
+    m_words: (16, N) uint32 — message words 0..7 as (lo, hi) interleaved
+    rows (row 2i = lo of 64-bit word i); words 8..15 are implicit zero.
+    Returns (8, N) uint32 — the 32-byte digest as interleaved (lo, hi).
+
+    unroll=False runs the 12 rounds as a lax.fori_loop with the per-round
+    message permutation done by one jnp.take over a (16, 2, N) word stack
+    — a fully-unrolled trace made XLA:CPU compilation pathological
+    (>10 min on one core) for identical runtime.  unroll=True emits the
+    static 12-round trace: required inside Mosaic kernels, where a
+    dynamic take of a value has no lowering (pallas_kernels).
+    """
+    ref = m_words[0]
+    zero = ref * 0
+    h = [_c64(x, ref) for x in _H0]
+    v = list(h + [_c64(x, ref) for x in _IV])
+    v[12] = _xor64(v[12], _c64(64, ref))           # t0 = 64 bytes
+    v[14] = _xor64(v[14], _c64(0xFFFFFFFFFFFFFFFF, ref))   # final block
+
+    def run_round(v, m):
+        _g(v, 0, 4, 8, 12, m[0], m[1])
+        _g(v, 1, 5, 9, 13, m[2], m[3])
+        _g(v, 2, 6, 10, 14, m[4], m[5])
+        _g(v, 3, 7, 11, 15, m[6], m[7])
+        _g(v, 0, 5, 10, 15, m[8], m[9])
+        _g(v, 1, 6, 11, 12, m[10], m[11])
+        _g(v, 2, 7, 8, 13, m[12], m[13])
+        _g(v, 3, 4, 9, 14, m[14], m[15])
+
+    if unroll:
+        m = [(m_words[2 * i], m_words[2 * i + 1]) for i in range(8)]
+        m = m + [(zero, zero)] * 8
+        for s in _ROUNDS:
+            run_round(v, [m[j] for j in s])
+    else:
+        m_stack = jnp.stack(
+            [jnp.stack([m_words[2 * i], m_words[2 * i + 1]])
+             for i in range(8)]
+            + [jnp.stack([zero, zero])] * 8)           # (16, 2, N)
+        sigma = jnp.asarray(_SIGMA_ARR)
+
+        def round_body(r, carry):
+            vv = [list(w) for w in carry]
+            msel = jnp.take(m_stack, jnp.take(sigma, r, axis=0), axis=0)
+            run_round(vv, [(msel[i, 0], msel[i, 1]) for i in range(16)])
+            return tuple(tuple(w) for w in vv)
+
+        v = list(jax.lax.fori_loop(0, 12, round_body,
+                                   tuple(tuple(w) for w in v)))
+    out = []
+    for i in range(4):
+        lo, hi = _xor64(_xor64(h[i], v[i]), v[i + 8])
+        out.extend((lo, hi))
+    return jnp.stack(out)
+
+
+def check_block64(m_words, expect_words):
+    """(16, N) message words + (8, N) expected digest words -> (N,) int32
+    equality mask — the device-compare form (only 4 bytes/item return)."""
+    d = compress_block64(m_words)
+    return jnp.all(d == expect_words, axis=0).astype(jnp.int32)
+
+
+check_block64_jit = jax.jit(check_block64)
+
+
+def digest_block64_jit(m_words):
+    return _digest_jit(m_words)
+
+
+_digest_jit = jax.jit(compress_block64)
+
+
+def msg_words(msgs64: np.ndarray) -> np.ndarray:
+    """(N, 64) uint8 rows -> (16, N) uint32 interleaved word rows."""
+    return np.ascontiguousarray(
+        msgs64.reshape(-1, 16, 4).view(np.uint32)[:, :, 0].T)
+
+
+def digest_words(digs32: np.ndarray) -> np.ndarray:
+    """(N, 32) uint8 digest rows -> (8, N) uint32 interleaved word rows."""
+    return np.ascontiguousarray(
+        digs32.reshape(-1, 8, 4).view(np.uint32)[:, :, 0].T)
+
+
+def blake2b_256_batch(msgs: list[bytes]) -> list[bytes]:
+    """Batched blake2b-256 of 64-byte messages (test/utility entry)."""
+    if not msgs:
+        return []
+    arr = np.frombuffer(b"".join(msgs), dtype=np.uint8).reshape(-1, 64)
+    out = np.asarray(digest_block64_jit(jnp.asarray(msg_words(arr))))
+    rows = out.T.copy().view(np.uint8)     # (N, 32)
+    return [rows[j].tobytes() for j in range(len(msgs))]

@@ -43,12 +43,14 @@ def _ensure_compile_cache() -> None:
     import os
     import tempfile
     try:
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         if jax.config.jax_compilation_cache_dir is not None:
-            return              # an application already configured a dir
+            # an application already configured a dir — leave its
+            # min-compile-time threshold alone too (ADVICE r4)
+            return
         d = os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
             tempfile.gettempdir(), "jax-ouro-cache")
         jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     except Exception:
         pass
 
@@ -78,15 +80,51 @@ def pt_add(p, q, n):
 
 def pt_double(p):
     X, Y, Z, _ = p
-    A = F.mul(X, X)
-    B = F.mul(Y, Y)
-    ZZ = F.mul(Z, Z)
+    A = F.sqr(X)
+    B = F.sqr(Y)
+    ZZ = F.sqr(Z)
     C = F.add(ZZ, ZZ)
     H = F.add(A, B)
     XY = F.add(X, Y)
-    E = F.sub(H, F.mul(XY, XY))
+    E = F.sub(H, F.sqr(XY))
     G = F.sub(A, B)
     Fv = F.add(C, G)
+    return (F.mul(E, Fv), F.mul(G, H), F.mul(Fv, G), F.mul(E, H))
+
+
+# -- cached-point form: q as (Y-X, Y+X, 2Z, 2dT), the ref10 "ge_cached"
+#    idea — one fewer field mul per ladder addition, and the 2d·T constant
+#    multiply moves into the (once-per-batch) table build.
+
+def to_cached(q, n):
+    X2, Y2, Z2, T2 = q
+    return (F.sub(Y2, X2), F.add(Y2, X2), F.add(Z2, Z2),
+            F.mul(T2, F.const_batch(_2D, n)))
+
+
+def const_cached(x: int, y: int, n):
+    """Cached form of a CONSTANT affine point (Z = 1)."""
+    return (F.const_batch((y - x) % ed.P, n),
+            F.const_batch((y + x) % ed.P, n),
+            F.const_batch(2, n),
+            F.const_batch(2 * ed.D * x * y % ed.P, n))
+
+
+def ident_cached(ref):
+    """Cached form of the identity (0, 1, 1, 0) -> (1, 1, 2, 0)."""
+    one = F.one_like(ref)
+    return (one, one, F.add(one, one), ref * 0)
+
+
+def pt_add_cached(p, q):
+    """p (extended) + q (cached): 8 field muls (pt_add is 9)."""
+    X1, Y1, Z1, T1 = p
+    c0, c1, z2, t2 = q
+    A = F.mul(F.sub(Y1, X1), c0)
+    B = F.mul(F.add(Y1, X1), c1)
+    C = F.mul(T1, t2)
+    D = F.mul(Z1, z2)
+    E, Fv, G, H = F.sub(B, A), F.sub(D, C), F.add(D, C), F.add(B, A)
     return (F.mul(E, Fv), F.mul(G, H), F.mul(Fv, G), F.mul(E, H))
 
 
@@ -194,13 +232,229 @@ def verify_core(negA_x, negA_y, negA_t, Rx, Ry, s_bits, k_bits, nbits=256):
 verify_kernel = jax.jit(verify_core, static_argnames=("nbits",))
 
 
+# ---------------------------------------------------------------------------
+# Split-128 ladder (VERDICT r4 next-step 1, the fixed-base direction):
+# write s = s_lo + 2^128·s_hi and k = k_lo + 2^128·k_hi, so
+#   Q = [s_lo]B + [s_hi]B' + [k_lo](-A) + [k_hi](-A')
+# with B' = [2^128]B a compile-time constant and A' = [2^128]A memoised
+# per verification key (keys repeat heavily on the replay path: pool
+# cold/KES keys sign thousands of headers, payment keys re-witness).
+# HALF the doubling chain of the 256-bit form: 128 doubles + 128 cached
+# adds + a 10-add/12-mul table build vs 256 + 128 + 9.
+# ---------------------------------------------------------------------------
+
+_GX_AFF, _GY_AFF = ed.to_affine(ed.BASE)
+_B128X, _B128Y = ed.to_affine(ed.scalar_mult(1 << 128, ed.BASE))
+_BB128X, _BB128Y = ed.to_affine(ed.scalar_mult((1 << 128) + 1, ed.BASE))
+
+
+def split_table_16(negA, negA128, n, ident):
+    """16 cached-form entries T[c + 4v]: c indexes the constant half
+    {1, B, B', B+B'}, v the variable half {1, -A, -A', -A-A'}."""
+    consts_aff = (None, (_GX_AFF, _GY_AFF), (_B128X, _B128Y),
+                  (_BB128X, _BB128Y))
+    var_ext = (None, negA, negA128, pt_add(negA, negA128, n))
+    table = []
+    for v in range(4):
+        for c in range(4):
+            if v == 0 and c == 0:
+                table.append(ident_cached(ident[0]))
+            elif v == 0:
+                x, y = consts_aff[c]
+                table.append(const_cached(x, y, n))
+            elif c == 0:
+                table.append(to_cached(var_ext[v], n))
+            else:
+                x, y = consts_aff[c]
+                cpt = (F.const_batch(x, n), F.const_batch(y, n),
+                       F.one_like(ident[1]),
+                       F.const_batch(x * y % ed.P, n))
+                table.append(to_cached(pt_add(var_ext[v], cpt, n), n))
+    return table
+
+
+def split_idx_rows(s_words, k_words):
+    """(8, N) uint32 scalar words -> (128, N) int32 joint window digits:
+    row i = s_lo + 2·s_hi + 4·k_lo + 8·k_hi at ladder iteration i
+    (MSB-first within each 128-bit half).  Cheap XLA elementwise work done
+    ON DEVICE so only the packed words cross the host link."""
+    rows = []
+    for i in range(128):
+        rows.append(F.bit_from_words(s_words, 127 - i)
+                    + 2 * F.bit_from_words(s_words, 255 - i)
+                    + 4 * F.bit_from_words(k_words, 127 - i)
+                    + 8 * F.bit_from_words(k_words, 255 - i))
+    return jnp.stack(rows)
+
+
+def verify_split_idx_core(negA, negA128, Rx, Ry, idx_rows):
+    """128-iteration split ladder; returns projective diffs vs affine R.
+
+    negA/negA128: extended-coordinate batches of -A and [2^128](-A);
+    idx_rows: (128, N) int32 joint digits (split_idx_rows)."""
+    ident = _identity_like(negA[0])
+    tbl = split_table_16(negA, negA128, negA[0].shape[1], ident)
+    table = tuple(jnp.stack([t[c] for t in tbl]) for c in range(4))
+
+    def body(i, Q):
+        Q = pt_double(Q)
+        idx = lax.dynamic_index_in_dim(idx_rows, i, 0, keepdims=False)
+        return pt_add_cached(Q, _onehot_entry(table, idx, 16))
+
+    Q = lax.fori_loop(0, 128, body, ident)
+    X, Y, Z, _ = Q
+    return F.sub(F.mul(Rx, Z), X), F.sub(F.mul(Ry, Z), Y)
+
+
+def verify_split_core(negA, negA128, Rx, Ry, s_bits, k_bits):
+    """Bit-rows form of the split ladder (s_bits/k_bits as (256, N)
+    MSB-first rows, same layout verify_core takes)."""
+    idx = (s_bits[128:] + 2 * s_bits[:128]
+           + 4 * k_bits[128:] + 8 * k_bits[:128])
+    return verify_split_idx_core(negA, negA128, Rx, Ry, idx)
+
+
+def verify_full_split_core(yA, signA, xA128, yA128, yR, signR,
+                           s_bits, k_bits):
+    """Whole split-ladder verification on device (the XLA form of the
+    pallas kernel in pallas_kernels._ed25519_split_kernel): decompress A
+    and R, negate A and the host-supplied affine A128, ladder, compare.
+    Returns (N,) int32 0/1."""
+    xA, okA = device_decompress(yA, signA)
+    xR, okR = device_decompress(yR, signR)
+    one = F.one_like(yA)
+    nax = F.sub(yA * 0, xA)
+    negA = (nax, yA, one, F.mul(nax, yA))
+    nax128 = F.sub(yA * 0, xA128)
+    negA128 = (nax128, yA128, one, F.mul(nax128, yA128))
+    d1, d2 = verify_split_core(negA, negA128, xR, yR, s_bits, k_bits)
+    ok = jnp.logical_and(jnp.logical_and(okA, okR),
+                         jnp.logical_and(F.is_zero(d1), F.is_zero(d2)))
+    return ok.astype(jnp.int32)
+
+
+verify_full_split_kernel = jax.jit(verify_full_split_core)
+
+
+def verify_full_split_words_core(Aw, signA, A128xw, A128yw, Rw, signR,
+                                 s_words, k_words):
+    """Packed-words form: all 256-bit inputs as (8, N) uint32 word rows
+    (8-32x smaller host->device transfers than limb/bit rows; see
+    field_jax packed-I/O notes).  Unpacks on device, then the split
+    ladder.  Returns (N,) int32 0/1."""
+    yA = F.limbs_from_words(Aw)
+    yR = F.limbs_from_words(Rw)
+    xA128 = F.limbs_from_words(A128xw)
+    yA128 = F.limbs_from_words(A128yw)
+    xA, okA = device_decompress(yA, signA)
+    xR, okR = device_decompress(yR, signR)
+    one = F.one_like(yA)
+    nax = F.sub(yA * 0, xA)
+    negA = (nax, yA, one, F.mul(nax, yA))
+    nax128 = F.sub(yA * 0, xA128)
+    negA128 = (nax128, yA128, one, F.mul(nax128, yA128))
+    idx = split_idx_rows(s_words, k_words)
+    d1, d2 = verify_split_idx_core(negA, negA128, xR, yR, idx)
+    ok = jnp.logical_and(jnp.logical_and(okA, okR),
+                         jnp.logical_and(F.is_zero(d1), F.is_zero(d2)))
+    return ok.astype(jnp.int32)
+
+
+verify_full_split_words_kernel = jax.jit(verify_full_split_words_core)
+
+
+def a128_core(yA, signA):
+    """[2^128]A for a batch of compressed keys: decompress + 128 doublings
+    + one batched inversion to canonical affine limbs.  Returns (x, y, ok).
+    Rare path (first sighting of a key); results are memoised by
+    A128Cache and fed to verify_full_split_core."""
+    xA, ok = device_decompress(yA, signA)
+    one = F.one_like(yA)
+    P = (xA, yA, one, F.mul(xA, yA))
+    P = lax.fori_loop(0, 128, lambda _, q: pt_double(q), P)
+    Zi = pow_inv(P[2])
+    return (F.canon(F.mul(P[0], Zi)), F.canon(F.mul(P[1], Zi)), ok)
+
+
+a128_kernel = jax.jit(a128_core)
+
+# filler for padding / undecodable keys: [2^128]B (any valid point works —
+# such entries are masked invalid by parse_ok before the result is read)
+def _words_of_int(v: int) -> np.ndarray:
+    return np.frombuffer(int(v).to_bytes(32, "little"),
+                         dtype=np.uint32).copy()
+
+
+_B128X_W = _words_of_int(_B128X)
+_B128Y_W = _words_of_int(_B128Y)
+
+
+class A128Cache:
+    """vk bytes -> affine words of [2^128]A, with batched device fill.
+
+    assemble() returns ((8, N) uint32 x-words, y-words) for a batch of
+    keys, computing every missing unique key in one a128_kernel call
+    (padded to a power-of-two bucket so repeats hit the jit cache)."""
+
+    def __init__(self, max_entries: int = 200_000):
+        self._c: dict = {}
+        self.max_entries = max_entries
+
+    def __len__(self):
+        return len(self._c)
+
+    def assemble(self, vks) -> tuple[np.ndarray, np.ndarray]:
+        missing = []
+        seen = set()
+        for vk in vks:
+            if vk in self._c or vk in seen:
+                continue
+            seen.add(vk)
+            missing.append(vk)
+        if missing:
+            self._fill(missing)
+        n = len(vks)
+        xs = np.empty((8, n), dtype=np.uint32)
+        ys = np.empty((8, n), dtype=np.uint32)
+        for j, vk in enumerate(vks):
+            ent = self._c.get(vk)
+            if ent is None:
+                xs[:, j], ys[:, j] = _B128X_W, _B128Y_W
+            else:
+                xs[:, j], ys[:, j] = ent
+        return xs, ys
+
+    def _fill(self, missing) -> None:
+        m = 128
+        while m < len(missing):
+            m *= 2
+        arr, len_ok = _bytes_rows(missing + [b"\x00" * 32] *
+                                  (m - len(missing)), 32)
+        yA, signA, y_ok = _decode_compressed(arr)
+        x, y, ok = a128_kernel(jnp.asarray(yA), jnp.asarray(signA))
+        xi = F.unpack(np.asarray(x))
+        yi = F.unpack(np.asarray(y))
+        ok = np.asarray(ok) & len_ok & y_ok
+        if len(self._c) + len(missing) > self.max_entries:
+            for k in list(self._c)[:len(self._c) // 2]:
+                del self._c[k]
+        for j, vk in enumerate(missing):
+            if ok[j]:
+                self._c[vk] = (_words_of_int(xi[j]), _words_of_int(yi[j]))
+            # undecodable keys stay uncached: assemble() fills B128 and
+            # parse_ok masks the lane invalid
+
+
+GLOBAL_A128_CACHE = A128Cache()
+
+
 def _sq_n(x, n):
-    return lax.fori_loop(0, n, lambda _, v: F.mul(v, v), x)
+    return lax.fori_loop(0, n, lambda _, v: F.sqr(v), x)
 
 
 def _chain250(z):
     """Shared ref10 ladder prefix: returns (z^(2^250-1), z^11, z^2)."""
-    z2 = F.mul(z, z)                      # 2
+    z2 = F.sqr(z)                         # 2
     z9 = F.mul(z, _sq_n(z2, 2))           # 9
     z11 = F.mul(z2, z9)                   # 11
     t0 = F.mul(z9, F.mul(z11, z11))       # 31 = 2^5 - 1
@@ -245,11 +499,11 @@ def decompress_kernel(y):
     """
     n = y.shape[1]
     one = F.one_like(y)
-    y2 = F.mul(y, y)
+    y2 = F.sqr(y)
     u = F.sub(y2, one)
     v = F.add(F.mul(F.const_batch(ed.D, n), y2), one)
-    v3 = F.mul(F.mul(v, v), v)
-    v7 = F.mul(F.mul(v3, v3), v)
+    v3 = F.mul(F.sqr(v), v)
+    v7 = F.mul(F.sqr(v3), v)
     return F.mul(F.mul(u, v3), pow_p58(F.mul(u, v7)))
 
 
@@ -262,13 +516,13 @@ def device_decompress(y, sign):
     edwards.decompress (host parse already rejected y >= p)."""
     n = y.shape[1]
     one = F.one_like(y)
-    y2 = F.mul(y, y)
+    y2 = F.sqr(y)
     u = F.sub(y2, one)
     v = F.add(F.mul(F.const_batch(ed.D, n), y2), one)
-    v3 = F.mul(F.mul(v, v), v)
-    v7 = F.mul(F.mul(v3, v3), v)
+    v3 = F.mul(F.sqr(v), v)
+    v7 = F.mul(F.sqr(v3), v)
     xc = F.mul(F.mul(u, v3), pow_p58(F.mul(u, v7)))
-    vx2 = F.mul(v, F.mul(xc, xc))
+    vx2 = F.mul(v, F.sqr(xc))
     root_direct = F.is_zero(F.sub(vx2, u))            # (N,) bool
     root_twist = F.is_zero(F.add(vx2, u))
     ok = jnp.logical_or(root_direct, root_twist)
@@ -493,6 +747,50 @@ def prepare_bytes_batch(vks, msgs, sigs):
     k_bits = np.unpackbits(k_rows, axis=1, bitorder="big")
     k_bits = np.ascontiguousarray(k_bits.T).astype(np.int32)
     return (yA, signA, yR, signR, s_bits, k_bits), parse_ok
+
+
+def _y_canonical(arr: np.ndarray) -> np.ndarray:
+    """(N, 32) little-endian point rows: mask of y < p with the sign bit
+    ignored (y >= p iff the 255 low bits are all-ones down to byte 1 and
+    byte 0 >= 0xED, since p = 2^255 - 19)."""
+    return ~(((arr[:, 31] & 0x7F) == 0x7F)
+             & (arr[:, 1:31] == 0xFF).all(axis=1)
+             & (arr[:, 0] >= 0xED))
+
+
+def prepare_words_batch(vks, msgs, sigs):
+    """Packed-words host prep for verify_full_split_words_kernel.
+
+    Returns ((Aw, signA, Rw, signR, sw, kw), parse_ok): the 256-bit
+    inputs as (8, N) uint32 word rows (sign bits cleared out of Aw/Rw
+    into the (N,) int32 sign vectors) — the transfer-thin form."""
+    n = len(vks)
+    vk_arr, vk_ok = _bytes_rows(vks, 32)
+    sig_arr, sig_ok = _bytes_rows(sigs, 64)
+    signA = (vk_arr[:, 31] >> 7).astype(np.int32)
+    signR = (sig_arr[:, 31] >> 7).astype(np.int32)
+    a_ok = _y_canonical(vk_arr)
+    r_ok = _y_canonical(sig_arr[:, :32])
+    s_rows = np.ascontiguousarray(sig_arr[:, 32:])
+    s_ok = _scalar_lt_L(s_rows)
+    parse_ok = vk_ok & sig_ok & a_ok & r_ok & s_ok
+    vk_clear = vk_arr.copy()
+    vk_clear[:, 31] &= 0x7F
+    r_clear = sig_arr[:, :32].copy()
+    r_clear[:, 31] &= 0x7F
+    k_bytes = bytearray()
+    for j in range(n):
+        if parse_ok[j]:
+            k = ed.sha512_int(bytes(sig_arr[j, :32]), bytes(vk_arr[j]),
+                              msgs[j]) % L
+        else:
+            k = 0
+        k_bytes += k.to_bytes(32, "little")
+    k_rows = np.frombuffer(bytes(k_bytes), dtype=np.uint8).reshape(n, 32)
+    return ((F.words_from_bytes_rows(vk_clear), signA,
+             F.words_from_bytes_rows(r_clear), signR,
+             F.words_from_bytes_rows(s_rows),
+             F.words_from_bytes_rows(k_rows)), parse_ok)
 
 
 def batch_verify(vks, msgs, sigs, pad_to: int | None = None) -> list[bool]:

@@ -172,6 +172,32 @@ def verify(depth: int, vk: bytes, period: int, msg: bytes, sig: KesSig) -> bool:
     return dsign.verify(expect_vk, msg, sig.leaf_sig)
 
 
+def verify_walk(depth: int, vk: bytes, period: int, sig: KesSig):
+    """Hash-free structural walk for device-batched verification.
+
+    Returns (leaf_vk, leaf_sig, jobs) where jobs is the list of
+    (64-byte message, expected 32-byte digest) Blake2b-256 checks the
+    hash path requires — the device kernel (blake2b_jax) verifies them
+    all in one batch; the KES signature is valid iff every job checks
+    out AND the leaf Ed25519 verify passes.  None if structurally
+    invalid (bad period / wrong path length)."""
+    if not 0 <= period < total_periods(depth) or len(sig.merkle) != depth:
+        return None
+    jobs = []
+    expect = vk
+    t = period
+    half = total_periods(depth) // 2
+    for vkl, vkr in reversed(sig.merkle):
+        jobs.append((vkl + vkr, expect))
+        if t < half:
+            expect = vkl
+        else:
+            expect = vkr
+            t -= half
+        half //= 2
+    return expect, sig.leaf_sig, jobs
+
+
 def verify_prepare(depth: int, vk: bytes, period: int, sig: KesSig):
     """Host-side half of batched verification: check the hash path and
     return the (leaf_vk, leaf_sig) pair for the device Ed25519 batch, or

@@ -174,6 +174,84 @@ def ed25519_verify_pallas(yA, signA, yR, signR, s_bits, k_bits, n: int):
 
 
 # ---------------------------------------------------------------------------
+# Split-128 Ed25519 kernel: ed25519_jax.verify_full_split_core as one fused
+# Mosaic program — 128 doublings instead of 256 (see the split-ladder notes
+# there; A128 = [2^128]A arrives from the host A128Cache).
+# ---------------------------------------------------------------------------
+
+def _ed25519_split_kernel(yA_ref, signA_ref, xA128_ref, yA128_ref,
+                          yR_ref, signR_ref, idx_ref, ok_ref):
+    yA = yA_ref[:]
+    yR = yR_ref[:]
+    xA128 = xA128_ref[:]
+    yA128 = yA128_ref[:]
+    xA, okA = EJ.device_decompress(yA, signA_ref[0, :])
+    xR, okR = EJ.device_decompress(yR, signR_ref[0, :])
+    one = F.one_like(yA)
+    nax = F.sub(yA * 0, xA)
+    negA = (nax, yA, one, F.mul(nax, yA))
+    nax128 = F.sub(yA * 0, xA128)
+    negA128 = (nax128, yA128, one, F.mul(nax128, yA128))
+    n = TILE
+    ident = EJ._identity_like(yA)
+    table = EJ.split_table_16(negA, negA128, n, ident)
+
+    def body(i, Q):
+        Q = _pt_double(Q)
+        return EJ.pt_add_cached(Q, _select16(table, idx_ref[i, :]))
+
+    Q = lax.fori_loop(0, 128, body, ident)
+    X, Y, Z, _ = Q
+    d1 = F.sub(F.mul(xR, Z), X)
+    d2 = F.sub(F.mul(yR, Z), Y)
+    ok = jnp.logical_and(jnp.logical_and(okA, okR),
+                         jnp.logical_and(F.is_zero(d1), F.is_zero(d2)))
+    ok_ref[0, :] = ok.astype(jnp.int32)
+
+
+def _ed25519_split_call(Aw, signA2d, A128xw, A128yw, Rw, signR2d,
+                        s_words, k_words, n: int):
+    """Packed-words entry: XLA unpacks words -> limbs / window digits on
+    device (tiny elementwise prologue), then the fused Mosaic ladder."""
+    yA = F.limbs_from_words(Aw)
+    yR = F.limbs_from_words(Rw)
+    xA128 = F.limbs_from_words(A128xw)
+    yA128 = F.limbs_from_words(A128yw)
+    idx = EJ.split_idx_rows(s_words, k_words)
+    grid = n // TILE
+    lane = lambda i: (0, i)
+    limb_spec = pl.BlockSpec((F.NLIMBS, TILE), lane,
+                             memory_space=pltpu.VMEM)
+    sign_spec = pl.BlockSpec((1, TILE), lane, memory_space=pltpu.VMEM)
+    idx_spec = pl.BlockSpec((128, TILE), lane, memory_space=pltpu.VMEM)
+    with F.mul_impl(_mul_form()):
+        return pl.pallas_call(
+            _ed25519_split_kernel,
+            grid=(grid,),
+            in_specs=[limb_spec, sign_spec, limb_spec, limb_spec,
+                      limb_spec, sign_spec, idx_spec],
+            out_specs=pl.BlockSpec((1, TILE), lane,
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((1, n), jnp.int32),
+            interpret=_interpret(),
+        )(yA, signA2d, xA128, yA128, yR, signR2d, idx)
+
+
+_ed25519_split_jit = jax.jit(_ed25519_split_call, static_argnames=("n",))
+
+
+def ed25519_split_pallas(Aw, signA, A128xw, A128yw, Rw, signR,
+                         s_words, k_words, n: int):
+    """Batched split-ladder Ed25519 verify, pallas path; inputs as
+    prepare_words_batch + A128Cache.assemble produce them."""
+    return _ed25519_split_jit(
+        jnp.asarray(Aw), jnp.asarray(signA).reshape(1, -1),
+        jnp.asarray(A128xw), jnp.asarray(A128yw),
+        jnp.asarray(Rw), jnp.asarray(signR).reshape(1, -1),
+        jnp.asarray(s_words), jnp.asarray(k_words), n)
+
+
+# ---------------------------------------------------------------------------
 # VRF (ECVRF-ED25519-SHA512-Elligator2) — the vrf_jax.vrf_verify_core device
 # half as one fused kernel
 # ---------------------------------------------------------------------------
@@ -213,22 +291,17 @@ def _compress_rows(x_aff, y_aff):
     return _bytes_rows_from_limbs(yc, xc[0] & 1)
 
 
-def _triple_ladder(P1, P1p, P2, lo_ref, hi_ref, c_ref, n):
-    """Q = [lo]P1 + [hi]P1' + [c]P2, 128 iterations, 8-entry where-select
-    (vrf_jax._triple_ladder_128, Mosaic-safe form: scalar-bit rows are read
-    from the refs — a dynamic_slice of a value has no lowering — and no
-    lane-direction concatenation anywhere)."""
+def _triple_ladder(P1, P1p, P2, idx_ref, n):
+    """Q = [lo]P1 + [hi]P1' + [c]P2, 128 iterations, 8-entry cached-form
+    where-select (vrf_jax._triple_ladder_idx, Mosaic-safe form: digit rows
+    are read from a ref — a dynamic_slice of a value has no lowering — and
+    no lane-direction concatenation anywhere)."""
     ident = EJ._identity_like(P1[0])
-    t3 = EJ.pt_add(P1, P1p, n)
-    t5 = EJ.pt_add(P1, P2, n)
-    t6 = EJ.pt_add(P1p, P2, n)
-    t7 = EJ.pt_add(t3, P2, n)
-    table = (ident, P1, P1p, t3, P2, t5, t6, t7)
+    table = _VJ._triple_table_cached(P1, P1p, P2, n)
 
     def body(i, Q):
         Q = EJ.pt_double(Q)
-        idx = lo_ref[i, :] + 2 * hi_ref[i, :] + 4 * c_ref[i, :]
-        return EJ.pt_add(Q, _select8(table, idx), n)
+        return EJ.pt_add_cached(Q, _select8(table, idx_ref[i, :]))
 
     return lax.fori_loop(0, 128, body, ident)
 
@@ -240,8 +313,8 @@ def _affine_bytes(pt, n):
 
 
 def _vrf_verify_kernel(yY_ref, signY_ref, yG_ref, signG_ref, r_ref,
-                       c_ref, lo_ref, hi_ref, out_ref):
-    """One TILE of the VRF device half (see vrf_jax.vrf_verify_core).
+                       idx_ref, out_ref):
+    """One TILE of the VRF device half (see vrf_jax.vrf_verify_idx_core).
 
     out rows: [0:32] H bytes, [32:64] U, [64:96] V, [96:128] [8]Gamma,
     [128] okY, [129] okG."""
@@ -263,8 +336,8 @@ def _vrf_verify_kernel(yY_ref, signY_ref, yG_ref, signG_ref, r_ref,
     Hp = lax.fori_loop(0, 128, lambda _, p: EJ.pt_double(p), H)
     negY = (nYx, yY, one, F.mul(nYx, yY))
     negG = (nGx, yG, one, F.mul(nGx, yG))
-    U = _triple_ladder(B, Bp, negY, lo_ref, hi_ref, c_ref, n)
-    V = _triple_ladder(H, Hp, negG, lo_ref, hi_ref, c_ref, n)
+    U = _triple_ladder(B, Bp, negY, idx_ref, n)
+    V = _triple_ladder(H, Hp, negG, idx_ref, n)
     out_ref[:] = jnp.concatenate(
         [_affine_bytes(H, n), _affine_bytes(U, n), _affine_bytes(V, n),
          _affine_bytes(G8, n),
@@ -280,25 +353,30 @@ _GX, _GY = _VJ._GX, _VJ._GY
 _G2X, _G2Y = _VJ._G2X, _VJ._G2Y
 
 
-def _vrf_verify_call(yY, signY2d, yG, signG2d, r, c_bits, lo_bits, hi_bits,
-                     n: int):
+def _vrf_verify_call(Yw, signY2d, Gw, signG2d, rw, cw, sw, n: int):
+    """Packed-words entry: XLA unpacks words -> limbs / digit rows on
+    device, then the fused Mosaic kernel."""
+    yY = F.limbs_from_words(Yw)
+    yG = F.limbs_from_words(Gw)
+    r = F.limbs_from_words(rw)
+    idx = _VJ._vrf_idx_rows(cw, sw)
     grid = n // TILE
     lane = lambda i: (0, i)
     limb_spec = pl.BlockSpec((F.NLIMBS, TILE), lane,
                              memory_space=pltpu.VMEM)
     sign_spec = pl.BlockSpec((1, TILE), lane, memory_space=pltpu.VMEM)
-    bits_spec = pl.BlockSpec((128, TILE), lane, memory_space=pltpu.VMEM)
+    idx_spec = pl.BlockSpec((128, TILE), lane, memory_space=pltpu.VMEM)
     with F.mul_impl(_mul_form()):
         rows = pl.pallas_call(
             _vrf_verify_kernel,
             grid=(grid,),
             in_specs=[limb_spec, sign_spec, limb_spec, sign_spec, limb_spec,
-                      bits_spec, bits_spec, bits_spec],
+                      idx_spec],
             out_specs=pl.BlockSpec((130, TILE), lane,
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((130, n), jnp.int32),
             interpret=_interpret(),
-        )(yY, signY2d, yG, signG2d, r, c_bits, lo_bits, hi_bits)
+        )(yY, signY2d, yG, signG2d, r, idx)
     # (N, 130) uint8, the layout vrf_jax._finish expects
     return rows.T.astype(jnp.uint8)
 
@@ -306,14 +384,13 @@ def _vrf_verify_call(yY, signY2d, yG, signG2d, r, c_bits, lo_bits, hi_bits,
 _vrf_verify_jit = jax.jit(_vrf_verify_call, static_argnames=("n",))
 
 
-def vrf_verify_pallas(yY, signY, yG, signG, r, c_bits, lo_bits, hi_bits):
-    """vrf_jax runner signature (drop-in for _submit's `runner` arg)."""
-    n = yY.shape[1]
+def vrf_verify_pallas(Yw, signY, Gw, signG, rw, cw, sw):
+    """vrf_jax packed runner (args as vrf_jax._prepare_words returns)."""
+    n = Yw.shape[1]
     return _vrf_verify_jit(
-        jnp.asarray(yY), jnp.asarray(signY).reshape(1, -1),
-        jnp.asarray(yG), jnp.asarray(signG).reshape(1, -1),
-        jnp.asarray(r), jnp.asarray(c_bits), jnp.asarray(lo_bits),
-        jnp.asarray(hi_bits), n)
+        jnp.asarray(Yw), jnp.asarray(signY).reshape(1, -1),
+        jnp.asarray(Gw), jnp.asarray(signG).reshape(1, -1),
+        jnp.asarray(rw), jnp.asarray(cw), jnp.asarray(sw), n)
 
 
 # ---------------------------------------------------------------------------
@@ -332,7 +409,8 @@ def _gamma8_kernel(yG_ref, signG_ref, out_ref):
         [comp, okG.astype(jnp.int32)[None, :]], axis=0)
 
 
-def _gamma8_call(yG, signG2d, n: int):
+def _gamma8_call(Gw, signG2d, n: int):
+    yG = F.limbs_from_words(Gw)
     grid = n // TILE
     lane = lambda i: (0, i)
     with F.mul_impl(_mul_form()):
@@ -354,11 +432,46 @@ def _gamma8_call(yG, signG2d, n: int):
 _gamma8_jit = jax.jit(_gamma8_call, static_argnames=("n",))
 
 
-def gamma8_pallas(yG, signG):
-    """vrf_jax._submit_betas runner signature."""
-    n = yG.shape[1]
-    return _gamma8_jit(jnp.asarray(yG), jnp.asarray(signG).reshape(1, -1),
+def gamma8_pallas(Gw, signG):
+    """vrf_jax._submit_betas packed runner (words input)."""
+    n = Gw.shape[1]
+    return _gamma8_jit(jnp.asarray(Gw), jnp.asarray(signG).reshape(1, -1),
                        n)
+
+
+# ---------------------------------------------------------------------------
+# KES hash-path check (blake2b_jax.check_block64) as a pallas kernel, so the
+# fused window composite stays homogeneous when the ladders run as Mosaic
+# ---------------------------------------------------------------------------
+
+def _kes_hash_kernel(m_ref, e_ref, ok_ref):
+    from . import blake2b_jax as B
+    # static 12-round unroll: a dynamic take of a value (the fori_loop
+    # sigma gather of the XLA form) has no Mosaic lowering
+    d = B.compress_block64(m_ref[:], unroll=True)
+    ok_ref[0, :] = jnp.all(d == e_ref[:], axis=0).astype(jnp.int32)
+
+
+def _kes_hash_call(mw, ew, n: int):
+    grid = n // TILE
+    lane = lambda i: (0, i)
+    return pl.pallas_call(
+        _kes_hash_kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((16, TILE), lane, memory_space=pltpu.VMEM),
+                  pl.BlockSpec((8, TILE), lane, memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, TILE), lane, memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.int32),
+        interpret=_interpret(),
+    )(mw, ew)
+
+
+_kes_hash_jit = jax.jit(_kes_hash_call, static_argnames=("n",))
+
+
+def kes_hash_pallas(mw, ew):
+    """(16, N) message words + (8, N) expected digests -> (1, N) ok."""
+    return _kes_hash_jit(jnp.asarray(mw), jnp.asarray(ew), mw.shape[1])
 
 
 def batch_verify_ed25519(vks, msgs, sigs) -> list[bool]:

@@ -54,36 +54,44 @@ def _double_n(pt, n_doublings: int):
                              lambda _, p: EJ.pt_double(p), pt)
 
 
-def _triple_ladder_128(P1, P1p, P2, lo_bits, hi_bits, c_bits):
-    """Q = [lo]P1 + [hi]P1' + [c]P2 in 128 iterations (all three scalars
-    are < 2^128: the verification scalar s splits as s = hi*2^128 + lo
-    with P1' = [2^128]P1, and the VRF challenge c is 16 bytes).  Halves
-    the doubling chain of the naive 256-iteration dual ladder.  Points in
-    full extended coordinates; returns projective (X, Y, Z)."""
-    n = P1[0].shape[1]
-    # 8-entry table over bit combinations (lo + 2*hi + 4*c)
+def _triple_table_cached(P1, P1p, P2, n):
+    """8-entry cached-form table over bit combinations lo + 2·hi + 4·c of
+    Q += [lo]P1 + [hi]P1' + [c]P2 (4 extended adds + 7 to_cached muls)."""
     ident = EJ._identity_like(P1[0])
     t3 = EJ.pt_add(P1, P1p, n)
     t5 = EJ.pt_add(P1, P2, n)
     t6 = EJ.pt_add(P1p, P2, n)
     t7 = EJ.pt_add(t3, P2, n)
-    table = tuple(jnp.stack([ident[c], P1[c], P1p[c], t3[c],
-                             P2[c], t5[c], t6[c], t7[c]])
-                  for c in range(4))
+    ext = (P1, P1p, t3, P2, t5, t6, t7)
+    return [EJ.ident_cached(P1[0])] + [EJ.to_cached(p, n) for p in ext]
+
+
+def _triple_ladder_idx(P1, P1p, P2, idx_rows):
+    """Q = [lo]P1 + [hi]P1' + [c]P2 in 128 iterations (all three scalars
+    are < 2^128: the verification scalar s splits as s = hi*2^128 + lo
+    with P1' = [2^128]P1, and the VRF challenge c is 16 bytes).  Halves
+    the doubling chain of the naive 256-iteration dual ladder.
+    idx_rows: (128, N) int32 digits lo + 2·hi + 4·c, MSB-first.
+    Cached-form table adds (one fewer mul per iteration).  Points in
+    full extended coordinates; returns projective (X, Y, Z)."""
+    n = P1[0].shape[1]
+    cach = _triple_table_cached(P1, P1p, P2, n)
+    table = tuple(jnp.stack([t[c] for t in cach]) for c in range(4))
+    ident = EJ._identity_like(P1[0])
 
     def body(i, Q):
         Q = EJ.pt_double(Q)
-        lo = jax.lax.dynamic_index_in_dim(lo_bits, i, 0, keepdims=False)
-        hi = jax.lax.dynamic_index_in_dim(hi_bits, i, 0, keepdims=False)
-        cb = jax.lax.dynamic_index_in_dim(c_bits, i, 0, keepdims=False)
-        idx = lo + 2 * hi + 4 * cb
-        sel = (idx[None, :] == jnp.arange(8, dtype=jnp.int32)[:, None])
-        sel = sel.astype(jnp.int32)[:, None, :]
-        entry = tuple(jnp.sum(table[c] * sel, axis=0) for c in range(4))
-        return EJ.pt_add(Q, entry, n)
+        idx = jax.lax.dynamic_index_in_dim(idx_rows, i, 0, keepdims=False)
+        return EJ.pt_add_cached(Q, EJ._onehot_entry(table, idx, 8))
 
     Q = jax.lax.fori_loop(0, 128, body, ident)
     return Q[0], Q[1], Q[2]
+
+
+def _triple_ladder_128(P1, P1p, P2, lo_bits, hi_bits, c_bits):
+    """Bit-rows compatibility wrapper around _triple_ladder_idx."""
+    return _triple_ladder_idx(P1, P1p, P2,
+                              lo_bits + 2 * hi_bits + 4 * c_bits)
 
 
 def _select(mask, a, b):
@@ -173,9 +181,10 @@ def compress_device(x_aff, y_aff):
     return byts.at[31].add(sign << 7)
 
 
-def vrf_verify_core(yY, signY, yG, signG, r, c_bits, s_lo_bits, s_hi_bits):
+def vrf_verify_idx_core(yY, signY, yG, signG, r, idx_rows):
     """Full device half of batched VRF verification.
 
+    idx_rows: (128, N) int32 joint digits lo + 2·hi + 4·c (MSB-first).
     Returns an (N, 130) uint8 array per item:
       [0:32]   compressed H        [32:64]  compressed U
       [64:96]  compressed V        [96:128] compressed [8]Gamma
@@ -203,10 +212,8 @@ def vrf_verify_core(yY, signY, yG, signG, r, c_bits, s_lo_bits, s_hi_bits):
     P1p = tuple(jnp.concatenate([Bp[c], Hp[c]], axis=1) for c in range(4))
     P2 = tuple(jnp.concatenate([negY[c], negG[c]], axis=1)
                for c in range(4))
-    lo2 = jnp.concatenate([s_lo_bits, s_lo_bits], axis=1)
-    hi2 = jnp.concatenate([s_hi_bits, s_hi_bits], axis=1)
-    c2 = jnp.concatenate([c_bits, c_bits], axis=1)
-    UV = _triple_ladder_128(P1, P1p, P2, lo2, hi2, c2)
+    idx2 = jnp.concatenate([idx_rows, idx_rows], axis=1)
+    UV = _triple_ladder_idx(P1, P1p, P2, idx2)
     # one inversion chain for every Z: [H | U | V | G8]
     Zall = jnp.concatenate([H[2], UV[2], G8[2]], axis=1)      # (NLIMBS, 4n)
     Zi = EJ.pow_inv(Zall)
@@ -220,7 +227,35 @@ def vrf_verify_core(yY, signY, yG, signG, r, c_bits, s_lo_bits, s_hi_bits):
     return rows.T.astype(jnp.uint8)                  # (n, 130)
 
 
+def vrf_verify_core(yY, signY, yG, signG, r, c_bits, s_lo_bits, s_hi_bits):
+    """Bit-rows compatibility form (parallel/sharded_verify wraps this)."""
+    return vrf_verify_idx_core(yY, signY, yG, signG, r,
+                               s_lo_bits + 2 * s_hi_bits + 4 * c_bits)
+
+
 vrf_verify_kernel = jax.jit(vrf_verify_core)
+
+
+def _vrf_idx_rows(c_words, s_words):
+    """(4, N) challenge words + (8, N) scalar words -> (128, N) digits."""
+    rows = []
+    for i in range(128):
+        rows.append(F.bit_from_words(s_words, 127 - i)
+                    + 2 * F.bit_from_words(s_words, 255 - i)
+                    + 4 * F.bit_from_words(c_words, 127 - i))
+    return jnp.stack(rows)
+
+
+def vrf_verify_words_core(Yw, signY, Gw, signG, rw, cw, sw):
+    """Packed-words form: 256-bit inputs as (8, N) uint32 word rows (the
+    challenge as (4, N)); unpacking happens on device.  Transfer-thin —
+    see field_jax packed-I/O notes."""
+    return vrf_verify_idx_core(
+        F.limbs_from_words(Yw), signY, F.limbs_from_words(Gw), signG,
+        F.limbs_from_words(rw), _vrf_idx_rows(cw, sw))
+
+
+vrf_verify_words_kernel = jax.jit(vrf_verify_words_core)
 
 
 @jax.jit
@@ -235,6 +270,33 @@ def gamma8_kernel(yG, signG):
     comp = compress_device(F.mul(G8[0], Zi), F.mul(G8[1], Zi))
     rows = jnp.concatenate([comp, okG.astype(jnp.int32)[None, :]], axis=0)
     return rows.T.astype(jnp.uint8)
+
+
+def gamma8_words_core(Gw, signG):
+    """Packed-words form of gamma8_kernel (unpack on device)."""
+    yG = F.limbs_from_words(Gw)
+    one = F.one_like(yG)
+    xG, okG = EJ.device_decompress(yG, signG)
+    G8 = _double3((xG, yG, one, F.mul(xG, yG)))
+    Zi = EJ.pow_inv(G8[2])
+    comp = compress_device(F.mul(G8[0], Zi), F.mul(G8[1], Zi))
+    rows = jnp.concatenate([comp, okG.astype(jnp.int32)[None, :]], axis=0)
+    return rows.T.astype(jnp.uint8)
+
+
+gamma8_words_kernel = jax.jit(gamma8_words_core)
+
+
+def _prepare_betas_words(proofs):
+    """Packed-words host parse of a gamma8 batch: ((Gw, signG), ok)."""
+    pf_arr, pf_ok = EJ._bytes_rows(proofs, PROOF_LEN)
+    signG = (pf_arr[:, 31] >> 7).astype(np.int32)
+    okGc = EJ._y_canonical(pf_arr[:, :32])
+    s_ok = EJ._scalar_lt_L(np.ascontiguousarray(pf_arr[:, 48:80]))
+    g_clear = pf_arr[:, :32].copy()
+    g_clear[:, 31] &= 0x7F
+    return ((F.words_from_bytes_rows(g_clear), signG),
+            pf_ok & okGc & s_ok)
 
 
 # ---------------------------------------------------------------------------
@@ -261,8 +323,11 @@ def _r_limbs(vks, alphas) -> np.ndarray:
     return limbs
 
 
-def _default_runner(*args):
-    return vrf_verify_kernel(*(jnp.asarray(a) for a in args))
+def _default_runner(Yw, signY, Gw, signG, rw, cw, sw):
+    return vrf_verify_words_kernel(
+        jnp.asarray(Yw), jnp.asarray(signY), jnp.asarray(Gw),
+        jnp.asarray(signG), jnp.asarray(rw), jnp.asarray(cw),
+        jnp.asarray(sw))
 
 
 def _prepare(vks, alphas, proofs):
@@ -288,12 +353,55 @@ def _prepare(vks, alphas, proofs):
     return args, parse_ok, gamma_ok, s_ok, pf_arr
 
 
+def _r_rows(vks, alphas) -> np.ndarray:
+    """Elligator2 input byte rows: r = SHA512(suite || 0x01 || vk ||
+    alpha)[:32] with the top bit masked (vrf_ref._hash_to_curve:25-27)."""
+    rows = bytearray()
+    for vk, alpha in zip(vks, alphas):
+        rows += hashlib.sha512(SUITE + b"\x01" + vk + alpha).digest()[:32]
+    arr = np.frombuffer(bytes(rows), dtype=np.uint8).reshape(len(vks), 32)
+    arr = arr.copy()
+    arr[:, 31] &= 0x7F
+    return arr
+
+
+def _prepare_words(vks, alphas, proofs):
+    """Packed-words host prep (the transfer-thin analog of _prepare).
+
+    Returns (kernel_args, parse_ok, gamma_ok, s_ok, pf_arr) with
+    kernel_args = (Yw, signY, Gw, signG, rw, cw, sw) — uint32 word rows
+    for vrf_verify_words_kernel / the pallas packed kernel."""
+    vk_arr, vk_ok = EJ._bytes_rows(vks, 32)
+    pf_arr, pf_ok = EJ._bytes_rows(proofs, PROOF_LEN)
+    signY = (vk_arr[:, 31] >> 7).astype(np.int32)
+    signG = (pf_arr[:, 31] >> 7).astype(np.int32)
+    okYc = EJ._y_canonical(vk_arr)
+    okGc = EJ._y_canonical(pf_arr[:, :32])
+    s_rows = np.ascontiguousarray(pf_arr[:, 48:80])
+    s_ok = EJ._scalar_lt_L(s_rows)
+    gamma_ok = pf_ok & okGc
+    parse_ok = vk_ok & okYc & gamma_ok & s_ok
+    vk_clear = vk_arr.copy()
+    vk_clear[:, 31] &= 0x7F
+    g_clear = pf_arr[:, :32].copy()
+    g_clear[:, 31] &= 0x7F
+    c_rows = np.ascontiguousarray(pf_arr[:, 32:48])
+    cw = np.ascontiguousarray(
+        c_rows.reshape(-1, 4, 4).view(np.uint32)[:, :, 0].T)
+    args = (F.words_from_bytes_rows(vk_clear), signY,
+            F.words_from_bytes_rows(g_clear), signG,
+            F.words_from_bytes_rows(_r_rows(vks, alphas)), cw,
+            F.words_from_bytes_rows(s_rows))
+    return args, parse_ok, gamma_ok, s_ok, pf_arr
+
+
 def _submit(vks, alphas, proofs, m, runner=None):
     """Parse + dispatch one padded batch; returns (device handle, masks,
     proof rows).  Does not block — callers may pipeline.  `runner` swaps
-    the kernel invocation (e.g. parallel.sharded_verify's mesh-sharded
-    variant)."""
-    args, parse_ok, gamma_ok, s_ok, pf_arr = _prepare(vks, alphas, proofs)
+    the kernel invocation (packed-words signature: Yw, signY, Gw, signG,
+    rw, cw, sw — e.g. pallas_kernels.vrf_verify_pallas)."""
+    args, parse_ok, gamma_ok, s_ok, pf_arr = _prepare_words(vks, alphas,
+                                                            proofs)
     handle = (runner or _default_runner)(*args)
     return handle, parse_ok, gamma_ok, s_ok, pf_arr
 
@@ -351,12 +459,13 @@ def _prepare_betas(proofs):
 
 
 def _submit_betas(proofs, m, runner=None):
-    """Parse + dispatch a gamma8 batch; returns (handle, decode_ok)."""
-    (yG, signG), decode_ok = _prepare_betas(proofs)
+    """Parse + dispatch a gamma8 batch; returns (handle, decode_ok).
+    `runner` takes the packed-words pair (Gw, signG)."""
+    (Gw, signG), decode_ok = _prepare_betas_words(proofs)
     if runner is None:
-        handle = gamma8_kernel(jnp.asarray(yG), jnp.asarray(signG))
+        handle = gamma8_words_kernel(jnp.asarray(Gw), jnp.asarray(signG))
     else:
-        handle = runner(yG, signG)
+        handle = runner(Gw, signG)
     return handle, decode_ok
 
 

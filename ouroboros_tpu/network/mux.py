@@ -149,6 +149,7 @@ class Mux:
         self.owd_observer = owd_observer
         self._channels: dict[tuple[int, int], MuxChannel] = {}
         self._jobs: list = []
+        self._demux_job = None
         # bumped on channel registration so the egress loop's STM retry
         # re-reads the channel set (a snapshot would miss late channels)
         self._chan_version = TVar(0, label=f"{label}.chanver")
@@ -166,8 +167,11 @@ class Mux:
     def start(self) -> None:
         self._jobs.append(sim.spawn(self._egress_loop(),
                                     label=f"{self.label}.muxer"))
-        self._jobs.append(sim.spawn(self._demux_loop(),
-                                    label=f"{self.label}.demuxer"))
+        # named, not positional: wait_closed() must track THIS job even if
+        # start() ever grows or reorders spawns (ADVICE r4)
+        self._demux_job = sim.spawn(self._demux_loop(),
+                                    label=f"{self.label}.demuxer")
+        self._jobs.append(self._demux_job)
 
     def stop(self) -> None:
         for j in self._jobs:
@@ -177,10 +181,10 @@ class Mux:
         """Block until the demuxer job ends — i.e. the bearer EOFed or
         errored (the connection-down signal servers hold on).  Returns
         immediately if the mux was never started."""
-        if len(self._jobs) < 2:
+        if self._demux_job is None:
             return
         try:
-            await self._jobs[1].wait()
+            await self._demux_job.wait()
         except BaseException:
             pass
 

@@ -21,11 +21,20 @@ class MsgProposeVersions:
     def encode_args(self):
         # versionTable is a CBOR MAP with unique ascending keys
         # (messages.cddl:108-115; Handshake/Codec.hs)
+        nums = [v for v, _p in self.versions]
+        if len(set(nums)) != len(nums):
+            raise ValueError("duplicate version numbers in proposal")
         return [{v: p for v, p in sorted(self.versions)}]
 
     @classmethod
     def decode_args(cls, a):
-        return cls(tuple(sorted((int(v), p) for v, p in a[0].items())))
+        # the CBOR layer already rejects duplicate keys; enforce the
+        # CDDL's ascending-order requirement here (the reference codec
+        # rejects misordered version tables too)
+        keys = [int(v) for v in a[0].keys()]
+        if keys != sorted(keys):
+            raise ValueError("version table keys not ascending")
+        return cls(tuple((int(v), p) for v, p in a[0].items()))
 
 
 @dataclass(frozen=True)

@@ -208,7 +208,11 @@ def fetch_decisions(
                 ps.done_through = None
         if blocks is None:
             blocks = frag.blocks
-        my_eta = (tracker.expected_fetch_time(est)
+        # symmetric race comparison (ADVICE r4): include OUR queue backlog
+        # exactly as expected_fetch_time does for the claimant, else a
+        # loaded fast peer wins duplicate races its backlog should lose
+        my_eta = (tracker.expected_fetch_time(
+                      max(ps.in_flight_bytes + est, est))
                   if tracker is not None else float("inf"))
         run: list = []
         start: Optional[Point] = None

@@ -34,7 +34,8 @@ from .block_fetch import (
 )
 from .blockchain_time import BlockchainTime
 from .chain_sync import CandidateState, chain_sync_client, chain_sync_server
-from .tx_submission import tx_inbound_loop, tx_outbound_loop
+from .tx_submission import (TxInboundProtocolError, tx_inbound_loop,
+                            tx_outbound_loop)
 
 # protocol numbers per NodeToNode.hs:211-212 (handshake=0, chainsync=2,
 # blockfetch=3, txsubmission=4, keepalive=8)
@@ -363,7 +364,8 @@ async def _run_initiator(initiator: NodeKernel, mux_i, peer_id,
             CodecChannel(mux_i.channel(TXSUBMISSION_NUM, INITIATOR),
                          tx_proto.CODEC))
         satellites.append(sim.spawn(
-            tx_outbound_loop(tx_out, initiator.mempool),
+            _supervise_tx(tx_outbound_loop(tx_out, initiator.mempool),
+                          initiator, mux_i, peer_id),
             label=f"{peer_id}.tx-out"))
     initiator._threads.extend(satellites)
 
@@ -426,9 +428,25 @@ async def _run_responder(responder: NodeKernel, mux_r, peer_id) -> None:
             CodecChannel(mux_r.channel(TXSUBMISSION_NUM, RESPONDER),
                          tx_proto.CODEC))
         responder._threads.append(sim.spawn(
-            tx_inbound_loop(tx_in, responder.mempool, responder.tx_decode),
+            _supervise_tx(
+                tx_inbound_loop(tx_in, responder.mempool,
+                                responder.tx_decode),
+                responder, mux_r, peer_id),
             label=f"{peer_id}.tx-in"))
     return "accepted"
+
+
+async def _supervise_tx(coro, kernel, mux, peer_id) -> None:
+    """Observe the TxSubmission loops: a window-contract violation is a
+    protocol error, so kill the whole connection (stop the mux — every
+    mini-protocol channel dies with it), matching the reference's
+    ProtocolError -> bearer-teardown path (TxSubmission/Inbound.hs)."""
+    try:
+        await coro
+    except TxInboundProtocolError as e:
+        sim.trace_event(("tx-protocol-kill", kernel.label, peer_id,
+                         str(e)))
+        mux.stop()
 
 
 async def _supervise_chain_sync(kernel: NodeKernel, session, candidate,

@@ -154,6 +154,9 @@ class ShardedJaxBackend(CryptoBackend):
         return run
 
     def verify_vrf_batch(self, reqs):
+        # the mesh runners shard the limb/bit-rows kernel form, so prep
+        # goes through vrf_jax._prepare directly (vrf_jax._submit moved
+        # to the packed-words single-chip form in r5)
         if not reqs:
             return []
         from ..crypto import vrf_jax
@@ -162,9 +165,11 @@ class ShardedJaxBackend(CryptoBackend):
         vks = [r.vk for r in reqs] + [b"\x00" * 32] * (m - n)
         alphas = [r.alpha for r in reqs] + [b""] * (m - n)
         proofs = [r.proof for r in reqs] + [b"\x00" * 80] * (m - n)
-        state = vrf_jax._submit(vks, alphas, proofs, m,
-                                runner=self._vrf_runner())
-        oks, _betas = vrf_jax._finish(*state, n)
+        args, parse_ok, gamma_ok, s_ok, pf_arr = vrf_jax._prepare(
+            vks, alphas, proofs)
+        handle = self._vrf_runner()(*args)
+        oks, _betas = vrf_jax._finish(handle, parse_ok, gamma_ok, s_ok,
+                                      pf_arr, n)
         return oks
 
     def vrf_betas_batch(self, proofs):
@@ -178,11 +183,9 @@ class ShardedJaxBackend(CryptoBackend):
         axis = self.mesh.axis_names[0]
         s2 = NamedSharding(self.mesh, P(None, axis))
         s1 = NamedSharding(self.mesh, P(axis))
-
-        def runner(yG, signG):
-            return fn(jax.device_put(np.asarray(yG), s2),
-                      jax.device_put(np.asarray(signG), s1))
-        handle, decode_ok = vrf_jax._submit_betas(padded, m, runner=runner)
+        (yG, signG), decode_ok = vrf_jax._prepare_betas(padded)
+        handle = fn(jax.device_put(np.asarray(yG), s2),
+                    jax.device_put(np.asarray(signG), s1))
         return vrf_jax._finish_betas(np.asarray(handle), decode_ok, n)
 
     # -- pipelined single-transfer window path ------------------------------
@@ -294,7 +297,10 @@ class ShardedJaxBackend(CryptoBackend):
                 "ed": ed_state, "ed_owner": ed_owner, "ne": ne,
                 "vrf": vrf_state, "vrf_owner": vrf_owner,
                 "vrf_n": len(vrf_reqs), "nv": nv,
-                "beta": beta_state, "beta_proofs": beta_proofs, "nb": nb}
+                "beta": beta_state, "beta_proofs": beta_proofs, "nb": nb,
+                # KES hash paths are reduced on host here (base
+                # split_mixed); keys kept for the shared finish_window
+                "kes_job_owner": [], "nk": 0, "kes_n": 0}
 
     # identical packed layout -> identical host-side unpacking
     from ..crypto.jax_backend import JaxBackend as _JB

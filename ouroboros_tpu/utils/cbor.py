@@ -163,6 +163,12 @@ class _Decoder:
                     # tuples so the dict stays usable; _encode re-emits
                     # tuples as arrays, preserving round-trips
                     k = _freeze(k)
+                if k in out:
+                    # RFC 8949 §5.6: maps with duplicate keys are invalid;
+                    # silently keeping the last key let a peer smuggle
+                    # conflicting entries past CDDL-unique-key rules
+                    # (ADVICE r4 on the handshake versionTable)
+                    raise CBORError(f"duplicate map key {k!r}")
                 out[k] = v
             return out
         if major == 6:

@@ -118,7 +118,7 @@ def test_triple_ladder_matches_xla_form_and_reference():
         def __getitem__(self, k):
             return self._a[k]
 
-    Q = PK._triple_ladder(P1, P1p, P2, _Ref(lo), _Ref(hi), _Ref(c), n)
+    Q = PK._triple_ladder(P1, P1p, P2, _Ref(lo + 2 * hi + 4 * c), n)
     Zi = np.asarray(Q[2])
     xs = F.unpack(np.asarray(Q[0]))
     ys = F.unpack(np.asarray(Q[1]))

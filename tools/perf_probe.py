@@ -4,11 +4,16 @@
 Times each (path, shape) with R repetitions and prints median + min/max —
 the measurement discipline VERDICT r3 asked for, in a standalone tool so
 kernel work can be steered by medians instead of single-shot noise.
+
+Paths: the r5 split-128 packed-words kernels (the production path) plus
+the r4 256-iteration limb/bit-row kernels for regression comparison.
 """
 import argparse
 import hashlib
+import os
 import statistics
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, __import__("os").path.dirname(
@@ -40,13 +45,17 @@ def main():
     ap.add_argument("--n-vrf", type=int, default=2048)
     ap.add_argument("--skip-vrf", action="store_true")
     ap.add_argument("--skip-xla", action="store_true")
+    ap.add_argument("--old", action="store_true",
+                    help="also run the r4 256-iteration kernels")
     args = ap.parse_args()
 
     import numpy as np
+
     import jax.numpy as jnp
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey,
     )
+
     from ouroboros_tpu.crypto import ed25519_jax as EJ
     from ouroboros_tpu.crypto import ed25519_ref, vrf_ref
     from ouroboros_tpu.crypto import pallas_kernels as PK
@@ -58,43 +67,76 @@ def main():
     vk = ed25519_ref.public_key(sk)
     msgs = [b"m%06d" % i for i in range(n)]
     sigs = [key.sign(m) for m in msgs]
-    arrays, parse_ok = EJ.prepare_bytes_batch([vk] * n, msgs, sigs)
-    arrs = [jnp.asarray(a) for a in arrays]
 
-    # --- Ed25519 XLA path
-    if not args.skip_xla:
-        def run_xla():
-            ok = np.asarray(EJ.verify_full_kernel(*arrs))
-            assert ok.sum() == n, ok.sum()
-        run_xla()   # compile
-        report("ed25519 XLA", n, timed(run_xla, args.reps))
-
-    # --- Ed25519 pallas path
-    yA, signA, yR, signR, s_bits, k_bits = arrs
-
-    def run_pallas():
-        ok = np.asarray(PK.ed25519_verify_pallas(
-            yA, signA, yR, signR, s_bits, k_bits, n))
+    # --- Ed25519 split/words (production): e2e incl. host prep
+    def run_split_e2e():
+        (Aw, signA, Rw, signR, sw, kw), parse_ok = EJ.prepare_words_batch(
+            [vk] * n, msgs, sigs)
+        xw, yw = EJ.GLOBAL_A128_CACHE.assemble([vk] * n)
+        ok = np.asarray(PK.ed25519_split_pallas(
+            Aw, signA, xw, yw, Rw, signR, sw, kw, n))
         assert ok.sum() == n, ok.sum()
-    run_pallas()    # compile
-    report("ed25519 pallas", n, timed(run_pallas, args.reps))
+    run_split_e2e()   # compile + cache fill
+    report("ed split pallas e2e", n, timed(run_split_e2e, args.reps))
+
+    # device-only (inputs pre-staged)
+    (Aw, signA, Rw, signR, sw, kw), _ = EJ.prepare_words_batch(
+        [vk] * n, msgs, sigs)
+    xw, yw = EJ.GLOBAL_A128_CACHE.assemble([vk] * n)
+    dev = [jnp.asarray(a) for a in
+           (Aw, signA.reshape(1, -1), xw, yw, Rw, signR.reshape(1, -1),
+            sw, kw)]
+
+    def run_split_dev():
+        ok = np.asarray(PK._ed25519_split_jit(*dev, n))
+        assert ok.sum() == n
+    report("ed split pallas device", n, timed(run_split_dev, args.reps))
+
+    if not args.skip_xla:
+        def run_split_xla():
+            ok = np.asarray(EJ.verify_full_split_words_kernel(
+                dev[0], dev[1][0], dev[2], dev[3], dev[4], dev[5][0],
+                dev[6], dev[7]))
+            assert ok.sum() == n
+        run_split_xla()
+        report("ed split XLA device", n, timed(run_split_xla, args.reps))
+
+    if args.old:
+        arrays, _parse_ok = EJ.prepare_bytes_batch([vk] * n, msgs, sigs)
+        arrs = [jnp.asarray(a) for a in arrays]
+        yA, signA_l, yR, signR_l, s_bits, k_bits = arrs
+
+        def run_old_pallas():
+            ok = np.asarray(PK.ed25519_verify_pallas(
+                yA, signA_l, yR, signR_l, s_bits, k_bits, n))
+            assert ok.sum() == n
+        run_old_pallas()
+        report("ed r4 pallas device", n, timed(run_old_pallas, args.reps))
 
     if args.skip_vrf:
         return
-    # --- VRF
+    # --- VRF (proof generation is pure-Python EC: cache to disk)
     nv = args.n_vrf
     vsk = hashlib.sha256(b"probe-vrf").digest()
     vvk = vrf_ref.public_key(vsk)
     alphas = [b"a%d" % i for i in range(nv)]
-    proofs = [vrf_ref.prove(vsk, a) for a in alphas]
+    cache = os.path.join(tempfile.gettempdir(),
+                         f"ouro-probe-proofs-{nv}.bin")
+    if os.path.exists(cache):
+        raw = open(cache, "rb").read()
+        proofs = [raw[i * 80:(i + 1) * 80] for i in range(nv)]
+    else:
+        proofs = [vrf_ref.prove(vsk, a) for a in alphas]
+        open(cache, "wb").write(b"".join(proofs))
 
     if not args.skip_xla:
         def run_vrf_xla():
-            st = vrf_jax._submit([vvk] * nv, alphas, proofs, nv, runner=None)
+            st = vrf_jax._submit([vvk] * nv, alphas, proofs, nv,
+                                 runner=None)
             oks, _ = vrf_jax._finish(*st, nv)
             assert all(oks)
         run_vrf_xla()
-        report("vrf XLA", nv, timed(run_vrf_xla, args.reps))
+        report("vrf words XLA e2e", nv, timed(run_vrf_xla, args.reps))
 
     def run_vrf_pallas():
         st = vrf_jax._submit([vvk] * nv, alphas, proofs, nv,
@@ -102,7 +144,16 @@ def main():
         oks, _ = vrf_jax._finish(*st, nv)
         assert all(oks)
     run_vrf_pallas()
-    report("vrf pallas", nv, timed(run_vrf_pallas, args.reps))
+    report("vrf words pallas e2e", nv, timed(run_vrf_pallas, args.reps))
+
+    # betas
+    def run_betas():
+        st, decode_ok = vrf_jax._submit_betas(proofs, nv,
+                                              runner=PK.gamma8_pallas)
+        bs = vrf_jax._finish_betas(np.asarray(st), decode_ok, nv)
+        assert all(b is not None for b in bs)
+    run_betas()
+    report("beta words pallas e2e", nv, timed(run_betas, args.reps))
 
 
 if __name__ == "__main__":
