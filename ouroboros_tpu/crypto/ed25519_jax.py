@@ -336,17 +336,20 @@ def verify_full_split_core(yA, signA, xA128, yA128, yR, signR,
 verify_full_split_kernel = jax.jit(verify_full_split_core)
 
 
-def verify_full_split_words_core(Aw, signA, A128xw, A128yw, Rw, signR,
+def verify_full_split_words_core(Aw, xAw, A128xw, A128yw, Rw, signR,
                                  s_words, k_words):
     """Packed-words form: all 256-bit inputs as (8, N) uint32 word rows
     (8-32x smaller host->device transfers than limb/bit rows; see
-    field_jax packed-I/O notes).  Unpacks on device, then the split
-    ladder.  Returns (N,) int32 0/1."""
+    field_jax packed-I/O notes).  A's affine x arrives from the A128Cache
+    (device-computed at first key sighting), so the only square root left
+    is R's — the probe measured each pow-chain decompression at ~20% of
+    the whole kernel.  Callers MUST mask lanes whose key was not `known`
+    to the cache.  Returns (N,) int32 0/1."""
     yA = F.limbs_from_words(Aw)
+    xA = F.limbs_from_words(xAw)
     yR = F.limbs_from_words(Rw)
     xA128 = F.limbs_from_words(A128xw)
     yA128 = F.limbs_from_words(A128yw)
-    xA, okA = device_decompress(yA, signA)
     xR, okR = device_decompress(yR, signR)
     one = F.one_like(yA)
     nax = F.sub(yA * 0, xA)
@@ -355,7 +358,7 @@ def verify_full_split_words_core(Aw, signA, A128xw, A128yw, Rw, signR,
     negA128 = (nax128, yA128, one, F.mul(nax128, yA128))
     idx = split_idx_rows(s_words, k_words)
     d1, d2 = verify_split_idx_core(negA, negA128, xR, yR, idx)
-    ok = jnp.logical_and(jnp.logical_and(okA, okR),
+    ok = jnp.logical_and(okR,
                          jnp.logical_and(F.is_zero(d1), F.is_zero(d2)))
     return ok.astype(jnp.int32)
 
@@ -364,16 +367,19 @@ verify_full_split_words_kernel = jax.jit(verify_full_split_words_core)
 
 
 def a128_core(yA, signA):
-    """[2^128]A for a batch of compressed keys: decompress + 128 doublings
-    + one batched inversion to canonical affine limbs.  Returns (x, y, ok).
+    """Per-key precompute: decompress A, then [2^128]A via 128 doublings
+    + one batched inversion to canonical affine limbs.  Returns
+    (xA, x128, y128, ok) — the key's own affine x AND the shifted point.
     Rare path (first sighting of a key); results are memoised by
-    A128Cache and fed to verify_full_split_core."""
+    A128Cache: steady-state verify kernels then skip the A square root
+    entirely (the r5 probe measured the two pow-chain decompressions at
+    ~40% of the split-ladder kernel)."""
     xA, ok = device_decompress(yA, signA)
     one = F.one_like(yA)
     P = (xA, yA, one, F.mul(xA, yA))
     P = lax.fori_loop(0, 128, lambda _, q: pt_double(q), P)
     Zi = pow_inv(P[2])
-    return (F.canon(F.mul(P[0], Zi)), F.canon(F.mul(P[1], Zi)), ok)
+    return (xA, F.canon(F.mul(P[0], Zi)), F.canon(F.mul(P[1], Zi)), ok)
 
 
 a128_kernel = jax.jit(a128_core)
@@ -389,12 +395,19 @@ _B128X_W = _words_of_int(_B128X)
 _B128Y_W = _words_of_int(_B128Y)
 
 
-class A128Cache:
-    """vk bytes -> affine words of [2^128]A, with batched device fill.
+_GX_W = _words_of_int(_GX_AFF)
 
-    assemble() returns ((8, N) uint32 x-words, y-words) for a batch of
-    keys, computing every missing unique key in one a128_kernel call
-    (padded to a power-of-two bucket so repeats hit the jit cache)."""
+
+class A128Cache:
+    """vk bytes -> affine words of (A, [2^128]A), with batched device fill.
+
+    assemble() returns ((8, N) uint32 xA-words, x128-words, y128-words,
+    known (N,) bool) for a batch of keys, computing every missing unique
+    key in one a128_kernel call (padded to a power-of-two bucket so
+    repeats hit the jit cache).  `known` is False for keys that failed
+    decompression (not on the curve / bad length) — callers must mask
+    those invalid, since the verify kernels trust the cached x and skip
+    the square-root check entirely."""
 
     def __init__(self, max_entries: int = 200_000):
         self._c: dict = {}
@@ -403,7 +416,7 @@ class A128Cache:
     def __len__(self):
         return len(self._c)
 
-    def assemble(self, vks) -> tuple[np.ndarray, np.ndarray]:
+    def assemble(self, vks):
         missing = []
         seen = set()
         for vk in vks:
@@ -414,15 +427,19 @@ class A128Cache:
         if missing:
             self._fill(missing)
         n = len(vks)
+        xa = np.empty((8, n), dtype=np.uint32)
         xs = np.empty((8, n), dtype=np.uint32)
         ys = np.empty((8, n), dtype=np.uint32)
+        known = np.zeros(n, dtype=bool)
         for j, vk in enumerate(vks):
             ent = self._c.get(vk)
             if ent is None:
-                xs[:, j], ys[:, j] = _B128X_W, _B128Y_W
+                # any valid point works: the lane is masked via `known`
+                xa[:, j], xs[:, j], ys[:, j] = _GX_W, _B128X_W, _B128Y_W
             else:
-                xs[:, j], ys[:, j] = ent
-        return xs, ys
+                xa[:, j], xs[:, j], ys[:, j] = ent
+                known[j] = True
+        return xa, xs, ys, known
 
     def _fill(self, missing) -> None:
         m = 128
@@ -431,7 +448,8 @@ class A128Cache:
         arr, len_ok = _bytes_rows(missing + [b"\x00" * 32] *
                                   (m - len(missing)), 32)
         yA, signA, y_ok = _decode_compressed(arr)
-        x, y, ok = a128_kernel(jnp.asarray(yA), jnp.asarray(signA))
+        xa, x, y, ok = a128_kernel(jnp.asarray(yA), jnp.asarray(signA))
+        xai = F.unpack(np.asarray(xa))
         xi = F.unpack(np.asarray(x))
         yi = F.unpack(np.asarray(y))
         ok = np.asarray(ok) & len_ok & y_ok
@@ -440,9 +458,10 @@ class A128Cache:
                 del self._c[k]
         for j, vk in enumerate(missing):
             if ok[j]:
-                self._c[vk] = (_words_of_int(xi[j]), _words_of_int(yi[j]))
-            # undecodable keys stay uncached: assemble() fills B128 and
-            # parse_ok masks the lane invalid
+                self._c[vk] = (_words_of_int(xai[j]), _words_of_int(xi[j]),
+                               _words_of_int(yi[j]))
+            # undecodable keys stay uncached: assemble() fills valid
+            # dummies and flags the lane not-known
 
 
 GLOBAL_A128_CACHE = A128Cache()

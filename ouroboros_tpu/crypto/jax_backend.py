@@ -193,7 +193,9 @@ class JaxBackend(CryptoBackend):
     # -- host prep ----------------------------------------------------------
     def _prep_ed(self, reqs, m: int):
         """Packed-words prep + A128 assembly for an Ed25519 batch padded
-        to m.  Returns (dev_args, parse_ok)."""
+        to m.  Returns (dev_args, parse_ok); keys the cache could not
+        decompress are masked out of parse_ok (the kernels trust the
+        cached affine x and skip the A square root)."""
         import jax.numpy as jnp
         pad = m - len(reqs)
         vks = [r.vk for r in reqs] + [b"\x00" * 32] * pad
@@ -201,21 +203,21 @@ class JaxBackend(CryptoBackend):
             vks,
             [r.msg for r in reqs] + [b""] * pad,
             [r.sig for r in reqs] + [b"\x00" * 64] * pad)
-        Aw, signA, Rw, signR, sw, kw = arrays
-        xw, yw = EJ.GLOBAL_A128_CACHE.assemble(vks)
-        args = (jnp.asarray(Aw), jnp.asarray(signA.reshape(1, -1)),
+        Aw, _signA, Rw, signR, sw, kw = arrays
+        xa, xw, yw, known = EJ.GLOBAL_A128_CACHE.assemble(vks)
+        args = (jnp.asarray(Aw), jnp.asarray(xa),
                 jnp.asarray(xw), jnp.asarray(yw),
                 jnp.asarray(Rw), jnp.asarray(signR.reshape(1, -1)),
                 jnp.asarray(sw), jnp.asarray(kw))
-        return args, parse_ok
+        return args, parse_ok & known
 
     def _ed_dispatch(self, args, m: int, use_pallas: bool):
         """Async-dispatch one prepared Ed25519 batch; (m,) int32 handle."""
         if use_pallas:
             return self._pk._ed25519_split_jit(*args, m).reshape(-1)
-        Aw, signA2, xw, yw, Rw, signR2, sw, kw = args
+        Aw, xa, xw, yw, Rw, signR2, sw, kw = args
         return EJ.verify_full_split_words_kernel(
-            Aw, signA2[0], xw, yw, Rw, signR2[0], sw, kw)
+            Aw, xa, xw, yw, Rw, signR2[0], sw, kw)
 
     def verify_ed25519_batch(self, reqs):
         if not reqs:
@@ -237,22 +239,24 @@ class JaxBackend(CryptoBackend):
 
         from . import vrf_jax
         pad = m - len(reqs)
+        vks = [r.vk for r in reqs] + [b"\x00" * 32] * pad
         args, parse_ok, gamma_ok, s_ok, pf_arr = vrf_jax._prepare_words(
-            [r.vk for r in reqs] + [b"\x00" * 32] * pad,
+            vks,
             [r.alpha for r in reqs] + [b""] * pad,
             [r.proof for r in reqs] + [b"\x00" * 80] * pad)
-        Yw, signY, Gw, signG, rw, cw, sw = args
-        dev = (jnp.asarray(Yw), jnp.asarray(signY.reshape(1, -1)),
+        Yw, _signY, Gw, signG, rw, cw, sw = args
+        xa, _x128, _y128, known = EJ.GLOBAL_A128_CACHE.assemble(vks)
+        dev = (jnp.asarray(Yw), jnp.asarray(xa),
                jnp.asarray(Gw), jnp.asarray(signG.reshape(1, -1)),
                jnp.asarray(rw), jnp.asarray(cw), jnp.asarray(sw))
-        return dev, (parse_ok, gamma_ok, s_ok, pf_arr)
+        return dev, (parse_ok & known, gamma_ok, s_ok, pf_arr)
 
     def _vrf_dispatch(self, dev, m: int, use_pallas: bool):
         from . import vrf_jax
         if use_pallas:
             return self._pk._vrf_verify_jit(*dev, m)
-        Yw, signY2, Gw, signG2, rw, cw, sw = dev
-        return vrf_jax.vrf_verify_words_kernel(Yw, signY2[0], Gw,
+        Yw, xa, Gw, signG2, rw, cw, sw = dev
+        return vrf_jax.vrf_verify_words_kernel(Yw, xa, Gw,
                                                signG2[0], rw, cw, sw)
 
     def verify_vrf_batch(self, reqs):
@@ -392,17 +396,17 @@ class JaxBackend(CryptoBackend):
                 if pallas:
                     ok = PK._ed25519_split_call(*ed_args, ne)
                 else:
-                    Aw, signA2, xw, yw, Rw, signR2, sw, kw = ed_args
+                    Aw, xa, xw, yw, Rw, signR2, sw, kw = ed_args
                     ok = EJ.verify_full_split_words_core(
-                        Aw, signA2[0], xw, yw, Rw, signR2[0], sw, kw)
+                        Aw, xa, xw, yw, Rw, signR2[0], sw, kw)
                 parts.append(ok.reshape(-1).astype(jnp.uint8))
             if vrf_args is not None:
                 if pallas:
                     rows = PK._vrf_verify_call(*vrf_args, nv)
                 else:
-                    Yw, sY2, Gw, sG2, rw, cw, sw = vrf_args
+                    Yw, xa, Gw, sG2, rw, cw, sw = vrf_args
                     rows = vrf_jax.vrf_verify_words_core(
-                        Yw, sY2[0], Gw, sG2[0], rw, cw, sw)
+                        Yw, xa, Gw, sG2[0], rw, cw, sw)
                 parts.append(rows.reshape(-1))
             if beta_args is not None:
                 if pallas:

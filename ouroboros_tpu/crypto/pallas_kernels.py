@@ -179,13 +179,13 @@ def ed25519_verify_pallas(yA, signA, yR, signR, s_bits, k_bits, n: int):
 # there; A128 = [2^128]A arrives from the host A128Cache).
 # ---------------------------------------------------------------------------
 
-def _ed25519_split_kernel(yA_ref, signA_ref, xA128_ref, yA128_ref,
+def _ed25519_split_kernel(yA_ref, xA_ref, xA128_ref, yA128_ref,
                           yR_ref, signR_ref, idx_ref, ok_ref):
     yA = yA_ref[:]
+    xA = xA_ref[:]
     yR = yR_ref[:]
     xA128 = xA128_ref[:]
     yA128 = yA128_ref[:]
-    xA, okA = EJ.device_decompress(yA, signA_ref[0, :])
     xR, okR = EJ.device_decompress(yR, signR_ref[0, :])
     one = F.one_like(yA)
     nax = F.sub(yA * 0, xA)
@@ -204,16 +204,19 @@ def _ed25519_split_kernel(yA_ref, signA_ref, xA128_ref, yA128_ref,
     X, Y, Z, _ = Q
     d1 = F.sub(F.mul(xR, Z), X)
     d2 = F.sub(F.mul(yR, Z), Y)
-    ok = jnp.logical_and(jnp.logical_and(okA, okR),
+    ok = jnp.logical_and(okR,
                          jnp.logical_and(F.is_zero(d1), F.is_zero(d2)))
     ok_ref[0, :] = ok.astype(jnp.int32)
 
 
-def _ed25519_split_call(Aw, signA2d, A128xw, A128yw, Rw, signR2d,
+def _ed25519_split_call(Aw, xAw, A128xw, A128yw, Rw, signR2d,
                         s_words, k_words, n: int):
     """Packed-words entry: XLA unpacks words -> limbs / window digits on
-    device (tiny elementwise prologue), then the fused Mosaic ladder."""
+    device (tiny elementwise prologue), then the fused Mosaic ladder.
+    A's affine x arrives from the A128Cache — callers mask not-`known`
+    lanes."""
     yA = F.limbs_from_words(Aw)
+    xA = F.limbs_from_words(xAw)
     yR = F.limbs_from_words(Rw)
     xA128 = F.limbs_from_words(A128xw)
     yA128 = F.limbs_from_words(A128yw)
@@ -228,24 +231,24 @@ def _ed25519_split_call(Aw, signA2d, A128xw, A128yw, Rw, signR2d,
         return pl.pallas_call(
             _ed25519_split_kernel,
             grid=(grid,),
-            in_specs=[limb_spec, sign_spec, limb_spec, limb_spec,
+            in_specs=[limb_spec, limb_spec, limb_spec, limb_spec,
                       limb_spec, sign_spec, idx_spec],
             out_specs=pl.BlockSpec((1, TILE), lane,
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((1, n), jnp.int32),
             interpret=_interpret(),
-        )(yA, signA2d, xA128, yA128, yR, signR2d, idx)
+        )(yA, xA, xA128, yA128, yR, signR2d, idx)
 
 
 _ed25519_split_jit = jax.jit(_ed25519_split_call, static_argnames=("n",))
 
 
-def ed25519_split_pallas(Aw, signA, A128xw, A128yw, Rw, signR,
+def ed25519_split_pallas(Aw, xAw, A128xw, A128yw, Rw, signR,
                          s_words, k_words, n: int):
     """Batched split-ladder Ed25519 verify, pallas path; inputs as
     prepare_words_batch + A128Cache.assemble produce them."""
     return _ed25519_split_jit(
-        jnp.asarray(Aw), jnp.asarray(signA).reshape(1, -1),
+        jnp.asarray(Aw), jnp.asarray(xAw),
         jnp.asarray(A128xw), jnp.asarray(A128yw),
         jnp.asarray(Rw), jnp.asarray(signR).reshape(1, -1),
         jnp.asarray(s_words), jnp.asarray(k_words), n)
@@ -312,19 +315,22 @@ def _affine_bytes(pt, n):
     return _compress_rows(F.mul(pt[0], Zi), F.mul(pt[1], Zi))
 
 
-def _vrf_verify_kernel(yY_ref, signY_ref, yG_ref, signG_ref, r_ref,
+def _vrf_verify_kernel(yY_ref, xY_ref, yG_ref, signG_ref, r_ref,
                        idx_ref, out_ref):
-    """One TILE of the VRF device half (see vrf_jax.vrf_verify_idx_core).
+    """One TILE of the VRF device half (vrf_jax.vrf_verify_idx_xy_core:
+    Y's affine x pre-resolved from the point cache, so only Gamma pays a
+    square-root chain).
 
     out rows: [0:32] H bytes, [32:64] U, [64:96] V, [96:128] [8]Gamma,
-    [128] okY, [129] okG."""
+    [128] okY (constant 1 — host folds the cache mask), [129] okG."""
     from . import vrf_jax as VJ
     n = TILE
     yY = yY_ref[:]
+    xY = xY_ref[:]
     yG = yG_ref[:]
     one = F.one_like(yY)
-    xY, okY = EJ.device_decompress(yY, signY_ref[0, :])
     xG, okG = EJ.device_decompress(yG, signG_ref[0, :])
+    okY = okG | True
     H = VJ._double3(VJ.elligator2_fraction(r_ref[:]))
     G8 = VJ._double3((xG, yG, one, F.mul(xG, yG)))
     nYx = F.sub(yY * 0, xY)
@@ -353,10 +359,11 @@ _GX, _GY = _VJ._GX, _VJ._GY
 _G2X, _G2Y = _VJ._G2X, _VJ._G2Y
 
 
-def _vrf_verify_call(Yw, signY2d, Gw, signG2d, rw, cw, sw, n: int):
+def _vrf_verify_call(Yw, xYw, Gw, signG2d, rw, cw, sw, n: int):
     """Packed-words entry: XLA unpacks words -> limbs / digit rows on
     device, then the fused Mosaic kernel."""
     yY = F.limbs_from_words(Yw)
+    xY = F.limbs_from_words(xYw)
     yG = F.limbs_from_words(Gw)
     r = F.limbs_from_words(rw)
     idx = _VJ._vrf_idx_rows(cw, sw)
@@ -370,13 +377,13 @@ def _vrf_verify_call(Yw, signY2d, Gw, signG2d, rw, cw, sw, n: int):
         rows = pl.pallas_call(
             _vrf_verify_kernel,
             grid=(grid,),
-            in_specs=[limb_spec, sign_spec, limb_spec, sign_spec, limb_spec,
+            in_specs=[limb_spec, limb_spec, limb_spec, sign_spec, limb_spec,
                       idx_spec],
             out_specs=pl.BlockSpec((130, TILE), lane,
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((130, n), jnp.int32),
             interpret=_interpret(),
-        )(yY, signY2d, yG, signG2d, r, idx)
+        )(yY, xY, yG, signG2d, r, idx)
     # (N, 130) uint8, the layout vrf_jax._finish expects
     return rows.T.astype(jnp.uint8)
 
@@ -384,11 +391,11 @@ def _vrf_verify_call(Yw, signY2d, Gw, signG2d, rw, cw, sw, n: int):
 _vrf_verify_jit = jax.jit(_vrf_verify_call, static_argnames=("n",))
 
 
-def vrf_verify_pallas(Yw, signY, Gw, signG, rw, cw, sw):
-    """vrf_jax packed runner (args as vrf_jax._prepare_words returns)."""
+def vrf_verify_pallas(Yw, xYw, Gw, signG, rw, cw, sw):
+    """vrf_jax packed runner (Y affine x from the point cache)."""
     n = Yw.shape[1]
     return _vrf_verify_jit(
-        jnp.asarray(Yw), jnp.asarray(signY).reshape(1, -1),
+        jnp.asarray(Yw), jnp.asarray(xYw),
         jnp.asarray(Gw), jnp.asarray(signG).reshape(1, -1),
         jnp.asarray(rw), jnp.asarray(cw), jnp.asarray(sw), n)
 

@@ -236,6 +236,44 @@ def vrf_verify_core(yY, signY, yG, signG, r, c_bits, s_lo_bits, s_hi_bits):
 vrf_verify_kernel = jax.jit(vrf_verify_core)
 
 
+def vrf_verify_idx_xy_core(yY, xY, yG, signG, r, idx_rows):
+    """Cached-Y form: the pool key's affine x arrives from the A128Cache
+    (pool keys repeat across a whole epoch of headers), skipping one of
+    the two pow-chain decompressions.  Row 128 (okY) is constant-true —
+    the host folds the cache's `known` mask into parse_ok instead."""
+    n = yY.shape[1]
+    one = F.one_like(yY)
+    xG, okG = EJ.device_decompress(yG, signG)
+    H = _double3(elligator2_fraction(r))
+    G8 = _double3((xG, yG, one, F.mul(xG, yG)))
+    nYx = F.sub(yY * 0, xY)
+    nGx = F.sub(yG * 0, xG)
+    B = (F.const_batch(_GX, n), F.const_batch(_GY, n), one,
+         F.const_batch(_GX * _GY % ed.P, n))
+    Bp = (F.const_batch(_G2X, n), F.const_batch(_G2Y, n), one,
+          F.const_batch(_G2X * _G2Y % ed.P, n))
+    Hp = _double_n(H, 128)
+    negY = (nYx, yY, one, F.mul(nYx, yY))
+    negG = (nGx, yG, one, F.mul(nGx, yG))
+    P1 = tuple(jnp.concatenate([B[c], H[c]], axis=1) for c in range(4))
+    P1p = tuple(jnp.concatenate([Bp[c], Hp[c]], axis=1) for c in range(4))
+    P2 = tuple(jnp.concatenate([negY[c], negG[c]], axis=1)
+               for c in range(4))
+    idx2 = jnp.concatenate([idx_rows, idx_rows], axis=1)
+    UV = _triple_ladder_idx(P1, P1p, P2, idx2)
+    Zall = jnp.concatenate([H[2], UV[2], G8[2]], axis=1)
+    Zi = EJ.pow_inv(Zall)
+    Xall = jnp.concatenate([H[0], UV[0], G8[0]], axis=1)
+    Yall = jnp.concatenate([H[1], UV[1], G8[1]], axis=1)
+    comp = compress_device(F.mul(Xall, Zi), F.mul(Yall, Zi))
+    ones = okG.astype(jnp.int32) * 0 + 1
+    rows = jnp.concatenate([comp[:, :n], comp[:, n:2 * n],
+                            comp[:, 2 * n:3 * n], comp[:, 3 * n:],
+                            ones[None, :],
+                            okG.astype(jnp.int32)[None, :]], axis=0)
+    return rows.T.astype(jnp.uint8)
+
+
 def _vrf_idx_rows(c_words, s_words):
     """(4, N) challenge words + (8, N) scalar words -> (128, N) digits."""
     rows = []
@@ -246,12 +284,14 @@ def _vrf_idx_rows(c_words, s_words):
     return jnp.stack(rows)
 
 
-def vrf_verify_words_core(Yw, signY, Gw, signG, rw, cw, sw):
+def vrf_verify_words_core(Yw, xYw, Gw, signG, rw, cw, sw):
     """Packed-words form: 256-bit inputs as (8, N) uint32 word rows (the
-    challenge as (4, N)); unpacking happens on device.  Transfer-thin —
-    see field_jax packed-I/O notes."""
-    return vrf_verify_idx_core(
-        F.limbs_from_words(Yw), signY, F.limbs_from_words(Gw), signG,
+    challenge as (4, N)); unpacking happens on device; Y's affine x comes
+    pre-resolved from the point cache.  Transfer-thin — see field_jax
+    packed-I/O notes."""
+    return vrf_verify_idx_xy_core(
+        F.limbs_from_words(Yw), F.limbs_from_words(xYw),
+        F.limbs_from_words(Gw), signG,
         F.limbs_from_words(rw), _vrf_idx_rows(cw, sw))
 
 
@@ -323,9 +363,9 @@ def _r_limbs(vks, alphas) -> np.ndarray:
     return limbs
 
 
-def _default_runner(Yw, signY, Gw, signG, rw, cw, sw):
+def _default_runner(Yw, xYw, Gw, signG, rw, cw, sw):
     return vrf_verify_words_kernel(
-        jnp.asarray(Yw), jnp.asarray(signY), jnp.asarray(Gw),
+        jnp.asarray(Yw), jnp.asarray(xYw), jnp.asarray(Gw),
         jnp.asarray(signG), jnp.asarray(rw), jnp.asarray(cw),
         jnp.asarray(sw))
 
@@ -398,12 +438,17 @@ def _prepare_words(vks, alphas, proofs):
 def _submit(vks, alphas, proofs, m, runner=None):
     """Parse + dispatch one padded batch; returns (device handle, masks,
     proof rows).  Does not block — callers may pipeline.  `runner` swaps
-    the kernel invocation (packed-words signature: Yw, signY, Gw, signG,
-    rw, cw, sw — e.g. pallas_kernels.vrf_verify_pallas)."""
+    the kernel invocation (packed-words signature: Yw, xYw, Gw, signG,
+    rw, cw, sw — e.g. pallas_kernels.vrf_verify_pallas).  Y's affine x
+    is resolved through the global point cache; unknown/bad keys fold
+    into parse_ok."""
+    from . import ed25519_jax as _EJ
     args, parse_ok, gamma_ok, s_ok, pf_arr = _prepare_words(vks, alphas,
                                                             proofs)
-    handle = (runner or _default_runner)(*args)
-    return handle, parse_ok, gamma_ok, s_ok, pf_arr
+    Yw, _signY, Gw, signG, rw, cw, sw = args
+    xa, _x128, _y128, known = _EJ.GLOBAL_A128_CACHE.assemble(list(vks))
+    handle = (runner or _default_runner)(Yw, xa, Gw, signG, rw, cw, sw)
+    return handle, parse_ok & known, gamma_ok, s_ok, pf_arr
 
 
 def _finish(handle, parse_ok, gamma_ok, s_ok, pf_arr, n):

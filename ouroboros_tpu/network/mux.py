@@ -98,10 +98,14 @@ class MuxChannel:
     async def send(self, data: bytes) -> None:
         """Queue bytes for egress; blocks while previous data undrained
         (the Wanton backpressure of Egress.hs:77).  Payloads larger than
-        the egress cap are enqueued in chunks as the muxer drains."""
+        the egress cap are enqueued in chunks as the muxer drains.
+        Raises MuxError once the mux is closed (teardown poisons the
+        channels — a blocked protocol must die, not hang)."""
         off = 0
         while off < len(data):
             def tx_fn(tx, off=off):
+                if tx.read(self._mux._closed):
+                    return None
                 cur = tx.read(self.egress)
                 room = self.EGRESS_CAP - len(cur)
                 if room <= 0:
@@ -109,17 +113,26 @@ class MuxChannel:
                 chunk = data[off:off + room]
                 tx.write(self.egress, cur + chunk)
                 return len(chunk)
-            off += await sim.atomically(tx_fn)
+            sent = await sim.atomically(tx_fn)
+            if sent is None:
+                raise MuxError(f"{self._mux.label}: mux closed")
+            off += sent
 
     async def recv(self) -> bytes:
-        """Receive whatever bytes have arrived (at least one)."""
+        """Receive whatever bytes have arrived (at least one); raises
+        MuxError when the mux closed with nothing pending."""
         def tx_fn(tx):
             buf = tx.read(self.ingress)
-            if not buf:
-                retry()
-            tx.write(self.ingress, b"")
-            return buf
-        return await sim.atomically(tx_fn)
+            if buf:
+                tx.write(self.ingress, b"")
+                return buf
+            if tx.read(self._mux._closed):
+                return None
+            retry()
+        out = await sim.atomically(tx_fn)
+        if out is None:
+            raise MuxError(f"{self._mux.label}: mux closed")
+        return out
 
     async def wait_ready(self, timeout: float) -> bool:
         """True when ingress bytes are pending, False after `timeout` —
@@ -150,6 +163,11 @@ class Mux:
         self._channels: dict[tuple[int, int], MuxChannel] = {}
         self._jobs: list = []
         self._demux_job = None
+        # set by stop() (and on demux/egress death): poisons every
+        # channel so blocked mini-protocols raise MuxError instead of
+        # hanging — the reference's mux teardown kills its protocol
+        # threads (Mux.hs JobPool cancellation)
+        self._closed = TVar(False, label=f"{label}.closed")
         # bumped on channel registration so the egress loop's STM retry
         # re-reads the channel set (a snapshot would miss late channels)
         self._chan_version = TVar(0, label=f"{label}.chanver")
@@ -174,8 +192,15 @@ class Mux:
         self._jobs.append(self._demux_job)
 
     def stop(self) -> None:
+        self._mark_closed()
         for j in self._jobs:
             j.cancel()
+
+    def _mark_closed(self) -> None:
+        try:
+            self._closed.set_notify(True)
+        except Exception:
+            self._closed._value = True
 
     async def wait_closed(self) -> None:
         """Block until the demuxer job ends — i.e. the bearer EOFed or
@@ -218,7 +243,16 @@ class Mux:
 
     async def _demux_loop(self):
         """Read SDUs, route to ingress queues; overflow kills the mux
-        (Ingress.hs:100-122 MuxIngressQueueOverRun semantics)."""
+        (Ingress.hs:100-122 MuxIngressQueueOverRun semantics).  Any exit
+        (bearer EOF/error/overflow) poisons the channels so protocol
+        threads blocked in recv/send fail rather than hang."""
+        try:
+            await self._demux_body()
+        except BaseException:
+            self._mark_closed()
+            raise
+
+    async def _demux_body(self):
         while True:
             sdu = await self.bearer.read()
             if self.owd_observer is not None:

@@ -308,12 +308,10 @@ def _connect_directional(initiator: NodeKernel, responder: NodeKernel,
     return handle
 
 
-async def _run_initiator(initiator: NodeKernel, mux_i, peer_id,
-                         tracker=None) -> None:
-    """The initiator-side connection runner.  Completes when the ChainSync
-    client ends (the connection's liveness signal — Client.hs kill
-    semantics); satellite protocols are cancelled on exit so subscription
-    workers can treat completion as connection-down and redial."""
+async def _initiator_handshake(initiator: NodeKernel, mux_i, peer_id):
+    """Version negotiation on protocol 0; returns the agreed version, or
+    None on refusal/magic mismatch (the warm-up step every outbound
+    connection — subscription-driven or governor-driven — runs first)."""
     versions = n2n.node_to_node_versions(initiator.network_magic)
     hs = Session(
         hs_proto.SPEC, CLIENT,
@@ -323,14 +321,34 @@ async def _run_initiator(initiator: NodeKernel, mux_i, peer_id,
     if res[0] != "accepted":
         sim.trace_event(("handshake-refused", initiator.label, peer_id,
                          res[1]))
-        return
+        return None
     _, version, params = res
     if dict(params or {}).get("magic") != initiator.network_magic:
         sim.trace_event(("handshake-magic-mismatch", initiator.label,
                          peer_id, params))
-        return
+        return None
     sim.trace_event(("handshake-ok", initiator.label, peer_id, version))
+    return version
 
+
+def _start_keepalive(initiator: NodeKernel, mux_i, peer_id, tracker):
+    """The WARM-stage protocol (the reference keeps KeepAlive running on
+    warm peers): RTT probes feeding the peer's GSV tracker."""
+    initiator.peer_gsv[peer_id] = tracker
+    ka_sess = Session(
+        ka_proto.SPEC, CLIENT,
+        CodecChannel(mux_i.channel(KEEPALIVE_NUM, INITIATOR),
+                     ka_proto.CODEC))
+    return sim.spawn(
+        ka_proto.client_probe(ka_sess, None, initiator.keepalive_interval,
+                              on_rtt=tracker.observe_rtt),
+        label=f"{peer_id}.ka-client")
+
+
+async def _run_hot(initiator: NodeKernel, mux_i, peer_id, version) -> None:
+    """The HOT protocol set: ChainSync (supervised, the liveness signal)
+    + BlockFetch client + TxSubmission outbound.  Returns when ChainSync
+    ends; cancels the satellites and releases the peer's candidate."""
     hdr_dec = initiator.header_decode
     blk_dec = initiator.block_decode_obj
     cs_codec = cs_proto.make_codec(hdr_dec) if hdr_dec else cs_proto.CODEC
@@ -346,17 +364,6 @@ async def _run_initiator(initiator: NodeKernel, mux_i, peer_id,
     satellites.append(sim.spawn(
         block_fetch_client(bf_sess, initiator, peer_id),
         label=f"{peer_id}.bf-client"))
-
-    tracker = tracker if tracker is not None else PeerGSVTracker()
-    initiator.peer_gsv[peer_id] = tracker
-    ka_sess = Session(
-        ka_proto.SPEC, CLIENT,
-        CodecChannel(mux_i.channel(KEEPALIVE_NUM, INITIATOR),
-                     ka_proto.CODEC))
-    satellites.append(sim.spawn(
-        ka_proto.client_probe(ka_sess, None, initiator.keepalive_interval,
-                              on_rtt=tracker.observe_rtt),
-        label=f"{peer_id}.ka-client"))
 
     if initiator.mempool is not None and version >= n2n.NODE_TO_NODE_V2:
         tx_out = Session(
@@ -379,6 +386,26 @@ async def _run_initiator(initiator: NodeKernel, mux_i, peer_id,
         for s in satellites:
             s.cancel()
         initiator.drop_peer(peer_id)
+
+
+async def _run_initiator(initiator: NodeKernel, mux_i, peer_id,
+                         tracker=None) -> None:
+    """The initiator-side connection runner (warm + hot in one go — the
+    subscription-worker path promotes straight to hot).  Completes when
+    the ChainSync client ends (the connection's liveness signal —
+    Client.hs kill semantics); satellite protocols are cancelled on exit
+    so subscription workers can treat completion as connection-down and
+    redial."""
+    version = await _initiator_handshake(initiator, mux_i, peer_id)
+    if version is None:
+        return
+    tracker = tracker if tracker is not None else PeerGSVTracker()
+    ka = _start_keepalive(initiator, mux_i, peer_id, tracker)
+    initiator._threads.append(ka)
+    try:
+        await _run_hot(initiator, mux_i, peer_id, version)
+    finally:
+        ka.cancel()
 
 
 async def _run_responder(responder: NodeKernel, mux_r, peer_id) -> None:

@@ -175,15 +175,17 @@ def test_split_words_verify_bit_exact_vs_reference():
     sigs[3] = sigs[3][:63] + bytes([sigs[3][63] ^ 1])
     vks[5] = b"\xff" * 32
     msgs[9] = b"other"
-    (Aw, signA, Rw, signR, sw, kw), parse_ok = EJ.prepare_words_batch(
+    (Aw, _signA, Rw, signR, sw, kw), parse_ok = EJ.prepare_words_batch(
         vks, msgs, sigs)
     cache = EJ.A128Cache()
-    xw, yw = cache.assemble(vks)
+    xa, xw, yw, known = cache.assemble(vks)
+    assert not known[5]                 # bad vk bytes -> not cacheable
     ok = np.asarray(EJ.verify_full_split_words_kernel(
-        jnp.asarray(Aw), jnp.asarray(signA), jnp.asarray(xw),
+        jnp.asarray(Aw), jnp.asarray(xa), jnp.asarray(xw),
         jnp.asarray(yw), jnp.asarray(Rw), jnp.asarray(signR),
         jnp.asarray(sw), jnp.asarray(kw)))
-    got = [bool(o) and bool(p) for o, p in zip(ok, parse_ok)]
+    got = [bool(o) and bool(p) and bool(k)
+           for o, p, k in zip(ok, parse_ok, known)]
     want = [ed25519_ref.verify(vks[i], msgs[i], sigs[i]) for i in range(n)]
     assert got == want
     # second assemble hits the cache (no growth)
@@ -196,12 +198,15 @@ def test_split_words_verify_bit_exact_vs_reference():
 def test_a128_cache_entries_match_scalar_mult():
     vk = ed25519_ref.public_key(hashlib.sha256(b"a128").digest())
     cache = EJ.A128Cache()
-    xw, yw = cache.assemble([vk])
+    xa, xw, yw, known = cache.assemble([vk])
+    assert known[0]
     A = ed.decompress(vk)
     wx, wy = ed.to_affine(ed.scalar_mult(1 << 128, A))
+    got_xa = int.from_bytes(xa[:, 0].tobytes(), "little")
     got_x = int.from_bytes(xw[:, 0].tobytes(), "little")
     got_y = int.from_bytes(yw[:, 0].tobytes(), "little")
     assert (got_x, got_y) == (wx, wy)
+    assert got_xa == ed.to_affine(A)[0]
 
 
 @pytest.mark.device

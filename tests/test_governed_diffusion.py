@@ -1,0 +1,134 @@
+"""Governor-driven diffusion: the cold→warm→hot promotion ladder as the
+peer-maintenance driver (VERDICT r4 missing #4 "the governor should be
+runnable").
+
+Reference behavior: Governor.hs:427-469 — the governed node must reach
+all three targets from a cold start (roots + gossip filling KnownPeers,
+promotions filling established/active) and must recover after an active
+peer is killed (failure feedback demotes, the loop re-promotes a
+replacement)."""
+from ouroboros_tpu import simharness as sim
+from ouroboros_tpu.network.peer_selection import PeerSelectionTargets
+from ouroboros_tpu.node.diffusion import (
+    SimNetwork, run_governed_diffusion, run_sim_diffusion,
+)
+from ouroboros_tpu.testing import PraosNetworkFactory, ThreadNetConfig
+
+
+def _mesh(factory, network, n, start=1):
+    """n plain listener nodes addr1..addrN serving the governed node."""
+    kernels = []
+    for i in range(start, start + n):
+        k = factory.make_node(i)
+        k.start()
+        network.listen(f"addr{i}", k)
+        kernels.append(k)
+    return kernels
+
+
+def test_governor_reaches_all_targets_from_cold():
+    cfg = ThreadNetConfig(n_nodes=6, n_slots=40, k=10, f=0.5, seed=9)
+    factory = PraosNetworkFactory(cfg)
+    targets = PeerSelectionTargets(target_known=5, target_established=3,
+                                   target_active=2)
+
+    async def main():
+        network = SimNetwork(link_delay=0.01)
+        peers = _mesh(factory, network, 5)
+        gk = factory.make_node(0)
+        gk.start()
+        all_addrs = [f"addr{i}" for i in range(1, 6)]
+        d = run_governed_diffusion(
+            gk, network, "addr0", root_peers=all_addrs[:2],
+            targets=targets, seed=3,
+            # peer sharing: an established peer gossips the whole mesh
+            gossip_fn=lambda addr: all_addrs)
+        await sim.sleep(30.0)
+        gov = d.tables["governor"]
+        sizes = (len(gov.known), len(gov.established), len(gov.active))
+        # the governed node's chain must actually follow the mesh (hot
+        # peers run real ChainSync/BlockFetch)
+        height = gk.chain_db.current_chain.head_block_no
+        peer_height = max(p.chain_db.current_chain.head_block_no
+                          for p in peers)
+        for k in peers + [gk]:
+            k.stop()
+        return sizes, height, peer_height, list(gov.active)
+
+    sizes, height, peer_height, active = sim.run(main(), seed=9)
+    assert sizes[0] >= 5                      # known target reached
+    assert sizes[1] == 3                      # established target
+    assert sizes[2] == 2                      # active target
+    assert height >= peer_height - 3          # actually syncing
+
+
+def test_governor_recovers_after_active_peer_kill():
+    cfg = ThreadNetConfig(n_nodes=6, n_slots=60, k=10, f=0.5, seed=11)
+    factory = PraosNetworkFactory(cfg)
+    targets = PeerSelectionTargets(target_known=5, target_established=3,
+                                   target_active=2)
+
+    async def main():
+        network = SimNetwork(link_delay=0.01)
+        peers = _mesh(factory, network, 5)
+        gk = factory.make_node(0)
+        gk.start()
+        all_addrs = [f"addr{i}" for i in range(1, 6)]
+        d = run_governed_diffusion(
+            gk, network, "addr0", root_peers=all_addrs,
+            targets=targets, seed=5)
+        await sim.sleep(20.0)
+        gov = d.tables["governor"]
+        actions = d.tables["actions"]
+        assert len(gov.active) == 2
+        victim = sorted(gov.active)[0]
+        # kill the connection out from under the governor: the hot job's
+        # ChainSync dies, on_down fires, the governor demotes + suspends
+        # the victim and promotes a replacement
+        actions.conns[victim].mux_i.stop()
+        # within the failure-backoff window: the replacement must be a
+        # DIFFERENT peer (the victim is suspended); re-admission later is
+        # legitimate governor behavior
+        await sim.sleep(8.0)
+        not_victim = victim not in gov.active
+        await sim.sleep(30.0)
+        recovered = (len(gov.active), len(gov.established))
+        trace_kinds = {k for _t, k, _a in gov.trace}
+        for k in peers + [gk]:
+            k.stop()
+        return recovered, not_victim, trace_kinds
+
+    recovered, not_victim, kinds = sim.run(main(), seed=11)
+    assert recovered[0] == 2 and recovered[1] == 3   # targets re-reached
+    assert not_victim                                # replacement differs
+    assert "promote-warm-to-hot" in kinds
+
+
+def test_governor_churn_rotates_active_set():
+    cfg = ThreadNetConfig(n_nodes=5, n_slots=80, k=10, f=0.5, seed=13)
+    factory = PraosNetworkFactory(cfg)
+    targets = PeerSelectionTargets(target_known=4, target_established=3,
+                                   target_active=1)
+
+    async def main():
+        network = SimNetwork(link_delay=0.01)
+        peers = _mesh(factory, network, 4)
+        gk = factory.make_node(0)
+        gk.start()
+        d = run_governed_diffusion(
+            gk, network, "addr0",
+            root_peers=[f"addr{i}" for i in range(1, 5)],
+            targets=targets, seed=7, churn_interval=15.0)
+        await sim.sleep(70.0)
+        gov = d.tables["governor"]
+        churned = [a for t, k, a in gov.trace if k == "churn"]
+        ever_active = {a for t, k, a in gov.trace
+                       if k == "promote-warm-to-hot"}
+        for k in peers + [gk]:
+            k.stop()
+        return churned, ever_active, len(gov.active)
+
+    churned, ever_active, n_active = sim.run(main(), seed=13)
+    assert len(churned) >= 3                 # rotation actually happened
+    assert len(ever_active) >= 2             # different peers got promoted
+    assert n_active == 1                     # target held through churn

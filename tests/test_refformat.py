@@ -98,6 +98,53 @@ class TestRoundTrip:
         assert [b.data for b in got] == [b"GOOD-BLOCK"]
 
 
+class TestGoldenFixture:
+    """Byte-golden chunk/primary/secondary triple checked into
+    tests/golden/refdb (hand-packed by GENERATOR.py straight from the
+    Primary.hs:82-92 / Secondary.hs layout, NOT via RefDbWriter), pinning
+    the read path independently of our writer (VERDICT r4 next-step 4)."""
+
+    FIXTURE = __file__.rsplit("/", 1)[0] + "/golden/refdb"
+
+    def _fs(self):
+        from ouroboros_tpu.storage.fs import IoFS
+        return IoFS(self.FIXTURE)
+
+    def test_fixture_bytes_unchanged(self):
+        """Any byte-level drift of the committed fixture fails loudly."""
+        import hashlib as H
+        digests = {}
+        for n in (0, 1):
+            for ext in ("chunk", "primary", "secondary"):
+                p = f"{self.FIXTURE}/immutable/{n:05d}.{ext}"
+                digests[f"{n:05d}.{ext}"] = H.sha256(
+                    open(p, "rb").read()).hexdigest()[:16]
+        assert digests == {
+            "00000.chunk": "47b1d546756e5527",
+            "00000.primary": "53915b617a98c90a",
+            "00000.secondary": "336e8d3e7c68e2af",
+            "00001.chunk": "3baaca7c3deb8c3b",
+            "00001.primary": "3e917e194c266ecc",
+            "00001.secondary": "e486b6fb622f9779",
+        }
+
+    def test_reader_parses_fixture(self):
+        fs = self._fs()
+        assert is_reference_db(fs)
+        got = list(RefDbReader(fs, chunk_size=4))
+        assert [b.data for b in got] == [
+            b"EBB-EPOCH-ZERO", b"BLOCK-AT-SLOT-ONE!", b"block@2",
+            b"SIXTH-SLOT-BLOCK"]
+        assert [b.entry.is_ebb for b in got] == [True, False, False, False]
+        assert [b.entry.slot(b.chunk_no, 4) for b in got] == [0, 1, 2, 6]
+        assert [b.chunk_no for b in got] == [0, 0, 0, 1]
+        assert got[0].entry.slot_or_epoch == 0          # epoch number
+        assert got[0].entry.header_hash == bytes(range(32))
+        from zlib import crc32 as _crc
+        for b in got:
+            assert b.entry.checksum == _crc(b.data)
+
+
 class TestSynthAnalyserInterop:
     @pytest.mark.parametrize("protocol", ["shelley"])
     def test_reference_format_replay_parity(self, tmp_path, protocol):

@@ -57,6 +57,7 @@ def load_db(db_dir: str):
         ledger = MockLedger({bytes.fromhex(vk): amt
                              for vk, amt in cfg["genesis"].items()})
         tx_decode = Tx.decode
+        tx_body_elems = None
     elif cfg["protocol"] == "cardano":
         from ouroboros_tpu.eras.cardano import (
             cardano_block_decode, cardano_setup,
@@ -94,6 +95,7 @@ def load_db(db_dir: str):
             {bytes.fromhex(a): amt for a, amt in cfg["genesis"].items()},
             tcfg, pools, delegs)
         tx_decode = ShelleyTx.decode
+        tx_body_elems = 6          # ShelleyTx: 6 body fields + witnesses
     else:
         raise SystemExit(f"unknown protocol {cfg['protocol']!r}")
 
@@ -101,8 +103,11 @@ def load_db(db_dir: str):
     fs = IoFS(db_dir)
     db = _open_immutable(fs, cfg)
 
-    def decode(raw: bytes) -> ProtocolBlock:
-        return ProtocolBlock.decode(cbor.loads(raw), tx_decode=tx_decode)
+    def decode(raw: bytes, _elems=tx_body_elems) -> ProtocolBlock:
+        # span-retaining decode: header bytes / KES message / tx ids come
+        # from raw slices instead of re-encoding (the replay host pass)
+        return ProtocolBlock.from_bytes(raw, tx_decode=tx_decode,
+                                        tx_body_elems=_elems)
 
     return db, rules, decode, cfg
 

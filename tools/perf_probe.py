@@ -70,22 +70,21 @@ def main():
 
     # --- Ed25519 split/words (production): e2e incl. host prep
     def run_split_e2e():
-        (Aw, signA, Rw, signR, sw, kw), parse_ok = EJ.prepare_words_batch(
+        (Aw, _sA, Rw, signR, sw, kw), parse_ok = EJ.prepare_words_batch(
             [vk] * n, msgs, sigs)
-        xw, yw = EJ.GLOBAL_A128_CACHE.assemble([vk] * n)
+        xa, xw, yw, known = EJ.GLOBAL_A128_CACHE.assemble([vk] * n)
         ok = np.asarray(PK.ed25519_split_pallas(
-            Aw, signA, xw, yw, Rw, signR, sw, kw, n))
-        assert ok.sum() == n, ok.sum()
+            Aw, xa, xw, yw, Rw, signR, sw, kw, n))
+        assert ok.sum() == n and known.all(), ok.sum()
     run_split_e2e()   # compile + cache fill
     report("ed split pallas e2e", n, timed(run_split_e2e, args.reps))
 
     # device-only (inputs pre-staged)
-    (Aw, signA, Rw, signR, sw, kw), _ = EJ.prepare_words_batch(
+    (Aw, _sA, Rw, signR, sw, kw), _ = EJ.prepare_words_batch(
         [vk] * n, msgs, sigs)
-    xw, yw = EJ.GLOBAL_A128_CACHE.assemble([vk] * n)
+    xa, xw, yw, _known = EJ.GLOBAL_A128_CACHE.assemble([vk] * n)
     dev = [jnp.asarray(a) for a in
-           (Aw, signA.reshape(1, -1), xw, yw, Rw, signR.reshape(1, -1),
-            sw, kw)]
+           (Aw, xa, xw, yw, Rw, signR.reshape(1, -1), sw, kw)]
 
     def run_split_dev():
         ok = np.asarray(PK._ed25519_split_jit(*dev, n))
@@ -95,7 +94,7 @@ def main():
     if not args.skip_xla:
         def run_split_xla():
             ok = np.asarray(EJ.verify_full_split_words_kernel(
-                dev[0], dev[1][0], dev[2], dev[3], dev[4], dev[5][0],
+                dev[0], dev[1], dev[2], dev[3], dev[4], dev[5][0],
                 dev[6], dev[7]))
             assert ok.sum() == n
         run_split_xla()
