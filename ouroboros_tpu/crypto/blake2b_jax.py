@@ -75,7 +75,7 @@ def _rotr64(a, r: int):
 def _c64(x: int, ref):
     """64-bit constant as a (lo, hi) pair broadcast to ref's lane shape."""
     z = ref * 0
-    return (z + np.uint32(x & 0xFFFFFFFF), z + np.uint32(x >> 32))
+    return (z + jnp.uint32(x & 0xFFFFFFFF), z + jnp.uint32(x >> 32))
 
 
 def _g(v, a, b, c, d, mx, my):

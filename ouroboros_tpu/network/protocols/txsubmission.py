@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from ..typed import CLIENT, NOBODY, SERVER, ProtocolSpec
+from ..typed import CLIENT, NOBODY, SERVER, ProtocolSpec, branch
 from .codec import Codec
 
 
@@ -90,8 +90,9 @@ SPEC = ProtocolSpec(
     agency={"TxIdle": SERVER, "TxIdsBlocking": CLIENT,
             "TxIdsNonBlocking": CLIENT, "TxTxs": CLIENT, "TxDone": NOBODY},
     transitions={
-        ("TxIdle", "MsgRequestTxIds"):
+        ("TxIdle", "MsgRequestTxIds"): branch(
             lambda m: "TxIdsBlocking" if m.blocking else "TxIdsNonBlocking",
+            "TxIdsBlocking", "TxIdsNonBlocking"),
         ("TxIdsBlocking", "MsgReplyTxIds"): "TxIdle",
         ("TxIdsBlocking", "MsgDone"): "TxDone",
         ("TxIdsNonBlocking", "MsgReplyTxIds"): "TxIdle",

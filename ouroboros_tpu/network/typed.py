@@ -29,6 +29,33 @@ class ProtocolError(Exception):
     """Agency/transition violation or codec failure."""
 
 
+def branch(fn: Callable, *targets: str) -> Callable:
+    """Tag a message-value-dependent transition callable with its
+    statically-known target states.
+
+    The Haskell reference encodes value-dependent branches in the Message
+    GADT's result index, so the compiler still sees every target state; a
+    bare Python callable hides them.  `branch` restores the static view:
+    ouro-lint's protocol pass (tools/analysis/protocol_pass.py) reads
+    `.targets` for reachability/totality and rejects opaque callables.
+    The returned dispatcher also enforces the declaration at run time, so
+    the analyzer's graph can't silently diverge from actual behaviour."""
+    if not targets:
+        raise ValueError("branch() needs at least one target state")
+    declared = frozenset(targets)
+
+    def dispatch(msg):
+        nxt = fn(msg)
+        if nxt not in declared:
+            raise ProtocolError(
+                f"branch callable returned undeclared state {nxt!r}; "
+                f"declared targets are {sorted(declared)}")
+        return nxt
+
+    dispatch.targets = tuple(targets)
+    return dispatch
+
+
 @dataclass(frozen=True)
 class ProtocolSpec:
     """States + agency + transitions for one mini-protocol.

@@ -1,0 +1,386 @@
+"""ouro-lint (tools/analysis) — live-tree gates + seeded-violation fixtures.
+
+Two test surfaces:
+(a) the three passes run over the live tree as tier-1 assertions: the
+    protocol pass must be clean with NO baseline help, the jax/sim passes
+    clean modulo the committed baseline;
+(b) fixture snippets with seeded violations prove every rule actually
+    fires (no false-negative lint) and that the allowlisted idioms don't
+    (no cheap false positives).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.analysis import Baseline, Finding, run_passes
+from tools.analysis.jax_pass import lint_source as jax_lint
+from tools.analysis.protocol_pass import (
+    check_spec, discover, message_inventory,
+)
+from tools.analysis.sim_pass import lint_source as sim_lint
+from ouroboros_tpu.network.protocols.codec import Codec
+from ouroboros_tpu.network.typed import (
+    CLIENT, NOBODY, SERVER, ProtocolSpec, branch,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --- (a) live tree ----------------------------------------------------------
+
+def test_protocol_pass_live_tree_clean_without_baseline():
+    """Acceptance: every discovered ProtocolSpec is sound with an empty
+    protocol baseline section."""
+    report = run_passes(["protocol"], Baseline())
+    assert report.new == [], "\n".join(f.render() for f in report.new)
+    assert Baseline.load().entries.get("protocol") == []
+
+
+def test_protocol_pass_discovers_enough_specs():
+    found = discover()
+    assert len(found) >= 10, [sym for *_rest, sym in found]
+    # every spec must have a paired codec on the live tree
+    assert all(codec is not None for _s, codec, *_r in found)
+
+
+def test_jax_and_sim_passes_clean_modulo_baseline():
+    report = run_passes(["jax", "sim"], Baseline.load())
+    assert report.new == [], "\n".join(f.render() for f in report.new)
+    assert report.stale == [], report.stale
+
+
+def test_baseline_entries_all_carry_justifications():
+    for name, entries in Baseline.load().entries.items():
+        for e in entries:
+            assert e["justification"].strip(), (name, e)
+            assert "TODO" not in e["justification"], (name, e)
+
+
+# --- (b) protocol-pass fixtures --------------------------------------------
+
+def _msg(name, tag):
+    return type(name, (), {
+        "TAG": tag,
+        "encode_args": lambda self: [],
+        "decode_args": classmethod(lambda cls, a: cls()),
+    })
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+def _check(spec, codec):
+    return check_spec(spec, codec, file="fixture.py", line=1, symbol="FX")
+
+
+def _codec(*names):
+    return Codec([_msg(n, i) for i, n in enumerate(names)])
+
+
+def test_proto001_fires_on_missing_agency_entry():
+    spec = ProtocolSpec(
+        name="fx", init_state="A",
+        agency={"A": CLIENT, "Done": NOBODY},       # "B" missing
+        transitions={("A", "MsgGo"): "B", ("B", "MsgBack"): "A",
+                     ("A", "MsgDone"): "Done"})
+    f = _check(spec, _codec("MsgGo", "MsgBack", "MsgDone"))
+    assert "PROTO001" in _rules(f)
+    assert any("'B'" in x.message for x in f if x.rule == "PROTO001")
+
+
+def test_proto001_fires_on_unknown_role():
+    spec = ProtocolSpec(
+        name="fx", init_state="A",
+        agency={"A": "anyone", "Done": NOBODY},
+        transitions={("A", "MsgDone"): "Done"})
+    f = _check(spec, _codec("MsgDone"))
+    assert "PROTO001" in _rules(f)
+
+
+def test_proto002_fires_on_non_nobody_terminal_state():
+    spec = ProtocolSpec(
+        name="fx", init_state="A",
+        agency={"A": CLIENT, "Done": SERVER},       # terminal but SERVER
+        transitions={("A", "MsgDone"): "Done"})
+    f = _check(spec, _codec("MsgDone"))
+    assert "PROTO002" in _rules(f)
+
+
+def test_proto002_fires_on_transition_out_of_nobody_state():
+    spec = ProtocolSpec(
+        name="fx", init_state="A",
+        agency={"A": CLIENT, "Done": NOBODY},
+        transitions={("A", "MsgDone"): "Done",
+                     ("Done", "MsgZombie"): "A"})   # NOBODY may not send
+    f = _check(spec, _codec("MsgDone", "MsgZombie"))
+    assert "PROTO002" in _rules(f)
+
+
+def test_proto003_fires_on_unreachable_state():
+    spec = ProtocolSpec(
+        name="fx", init_state="A",
+        agency={"A": CLIENT, "Lost": SERVER, "Done": NOBODY},
+        transitions={("A", "MsgDone"): "Done",
+                     ("Lost", "MsgBack"): "A"})     # nothing reaches Lost
+    f = _check(spec, _codec("MsgDone", "MsgBack"))
+    assert "PROTO003" in _rules(f)
+
+
+def test_proto004_fires_on_opaque_branch_and_branch_helper_clears_it():
+    opaque = ProtocolSpec(
+        name="fx", init_state="A",
+        agency={"A": CLIENT, "B": SERVER, "Done": NOBODY},
+        transitions={("A", "MsgGo"): lambda m: "B",
+                     ("B", "MsgBack"): "A", ("A", "MsgDone"): "Done"})
+    f = _check(opaque, _codec("MsgGo", "MsgBack", "MsgDone"))
+    assert "PROTO004" in _rules(f)
+    declared = ProtocolSpec(
+        name="fx", init_state="A",
+        agency={"A": CLIENT, "B": SERVER, "Done": NOBODY},
+        transitions={("A", "MsgGo"): branch(lambda m: "B", "B"),
+                     ("B", "MsgBack"): "A", ("A", "MsgDone"): "Done"})
+    assert _check(declared, _codec("MsgGo", "MsgBack", "MsgDone")) == []
+
+
+def test_proto005_006_007_codec_coverage_both_ways():
+    spec = ProtocolSpec(
+        name="fx", init_state="A",
+        agency={"A": CLIENT, "Done": NOBODY},
+        transitions={("A", "MsgDone"): "Done"})
+    missing = _check(spec, _codec())                 # MsgDone unregistered
+    assert "PROTO005" in _rules(missing)
+    orphan = _check(spec, _codec("MsgDone", "MsgGhost"))
+    assert "PROTO006" in _rules(orphan)
+    assert "PROTO007" in _rules(_check(spec, None))
+
+
+def test_protocol_pass_accepts_a_sound_spec():
+    spec = ProtocolSpec(
+        name="fx", init_state="A",
+        agency={"A": CLIENT, "B": SERVER, "Done": NOBODY},
+        transitions={("A", "MsgGo"): "B", ("B", "MsgBack"): "A",
+                     ("A", "MsgDone"): "Done"})
+    assert _check(spec, _codec("MsgGo", "MsgBack", "MsgDone")) == []
+
+
+# --- (b) jax-pass fixtures --------------------------------------------------
+
+def test_jax001_int_on_traced_value_fires():
+    f = jax_lint(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return int(x) + 1\n", "fx.py")
+    assert _rules(f) == {"JAX001"}
+
+
+def test_jax001_static_shapes_allowed():
+    f = jax_lint(
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    n = int(x.shape[0])\n"
+        "    m = bool(x.ndim - 1)\n"
+        "    return n + int(len(x.shape)) + m\n", "fx.py")
+    assert f == []
+
+
+def test_jax002_item_fires_including_via_lax_callee():
+    f = jax_lint(
+        "from jax import lax\n"
+        "def body(i, acc):\n"
+        "    return acc + acc.item()\n"
+        "def outer(x):\n"
+        "    return lax.fori_loop(0, 3, body, x)\n", "fx.py")
+    assert _rules(f) == {"JAX002"}
+    assert f[0].symbol == "body"
+
+
+def test_jax003_numpy_in_jit_fires_transitively():
+    f = jax_lint(
+        "import jax\n"
+        "import numpy as np\n"
+        "def helper(x):\n"
+        "    return np.sum(x)\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return helper(x)\n", "fx.py")
+    assert _rules(f) == {"JAX003"}
+
+
+def test_jax003_numpy_outside_jit_is_fine():
+    f = jax_lint(
+        "import numpy as np\n"
+        "def host_prep(x):\n"
+        "    return np.asarray(x)\n", "fx.py")
+    assert f == []
+
+
+def test_jax004_jit_per_call_fires_and_lru_cache_clears_it():
+    bad = jax_lint(
+        "import jax\n"
+        "def make(x):\n"
+        "    return jax.jit(lambda y: y + 1)(x)\n", "fx.py")
+    assert "JAX004" in _rules(bad)
+    good = jax_lint(
+        "import functools\n"
+        "import jax\n"
+        "@functools.lru_cache(maxsize=8)\n"
+        "def make():\n"
+        "    return jax.jit(lambda y: y + 1)\n", "fx.py")
+    assert "JAX004" not in _rules(good)
+    module_level = jax_lint(
+        "import jax\n"
+        "def f(y):\n"
+        "    return y + 1\n"
+        "g = jax.jit(f)\n", "fx.py")
+    assert "JAX004" not in _rules(module_level)
+
+
+def test_jax005_lambda_into_jitted_callable_fires():
+    f = jax_lint(
+        "import jax\n"
+        "def apply(fn, x):\n"
+        "    return fn(x)\n"
+        "fast = jax.jit(apply)\n"
+        "def caller(x):\n"
+        "    return fast(lambda v: v * 2, x)\n", "fx.py")
+    assert "JAX005" in _rules(f)
+    # ...but a lambda into the RAW (un-jitted) callable is harmless
+    raw = jax_lint(
+        "import jax\n"
+        "def apply(fn, x):\n"
+        "    return fn(x)\n"
+        "fast = jax.jit(apply)\n"
+        "def caller(x):\n"
+        "    return apply(lambda v: v * 2, x)\n", "fx.py")
+    assert "JAX005" not in _rules(raw)
+    # a @jax.jit-decorated def is itself the wrapper
+    deco = jax_lint(
+        "import jax\n"
+        "@jax.jit\n"
+        "def fast(fn, x):\n"
+        "    return fn(x)\n"
+        "def caller(x):\n"
+        "    return fast(lambda v: v * 2, x)\n", "fx.py")
+    assert "JAX005" in _rules(deco)
+
+
+def test_branch_enforces_declared_targets_at_runtime():
+    from ouroboros_tpu.network.typed import ProtocolError
+    good = branch(lambda m: "B" if m else "C", "B", "C")
+    assert good(True) == "B" and good(False) == "C"
+    lying = branch(lambda m: "Typo", "B")
+    with pytest.raises(ProtocolError):
+        lying(object())
+
+
+# --- (b) sim-pass fixtures --------------------------------------------------
+
+def test_sim001_time_sleep_in_async_fires_sync_allowed():
+    f = sim_lint(
+        "import time\n"
+        "async def poll():\n"
+        "    time.sleep(1)\n", "fx.py")
+    assert _rules(f) == {"SIM001"}
+    assert sim_lint(
+        "import time\n"
+        "def host_only():\n"
+        "    time.sleep(1)\n", "fx.py") == []
+
+
+def test_sim002_global_rng_fires_seeded_instance_allowed():
+    f = sim_lint(
+        "import random\n"
+        "async def pick(xs):\n"
+        "    return random.choice(xs)\n", "fx.py")
+    assert _rules(f) == {"SIM002"}
+    assert sim_lint(
+        "import random\n"
+        "async def pick(xs, seed):\n"
+        "    rng = random.Random(seed)\n"
+        "    return rng.choice(xs)\n", "fx.py") == []
+
+
+def test_sim003_threading_fires():
+    f = sim_lint(
+        "import threading\n"
+        "async def go(fn):\n"
+        "    threading.Thread(target=fn).start()\n", "fx.py")
+    assert "SIM003" in _rules(f)
+
+
+def test_sim004_socket_call_fires_constants_allowed():
+    f = sim_lint(
+        "import socket\n"
+        "async def dial(addr):\n"
+        "    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)\n"
+        "    return s\n", "fx.py")
+    assert _rules(f) == {"SIM004"} and len(f) == 1
+    assert sim_lint(
+        "import socket\n"
+        "async def family(addr):\n"
+        "    return socket.AF_INET6 if ':' in addr else socket.AF_INET\n",
+        "fx.py") == []
+
+
+def test_sim005_blocking_open_fires_in_nested_helper_too():
+    f = sim_lint(
+        "async def load(path):\n"
+        "    def slurp():\n"
+        "        with open(path) as fh:\n"
+        "            return fh.read()\n"
+        "    return slurp()\n", "fx.py")
+    assert _rules(f) == {"SIM005"}
+    assert f[0].symbol == "load.slurp"
+
+
+# --- CLI exit-code semantics ------------------------------------------------
+
+def _cli(*argv):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.analysis", *argv],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_cli_strict_clean_on_live_tree():
+    r = _cli("--strict")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_exit_1_when_baseline_ignored():
+    # the committed baseline is non-empty, so --no-baseline must block
+    assert Baseline.load().entries["jax"] or Baseline.load().entries["sim"]
+    r = _cli("--no-baseline")
+    assert r.returncode == 1, r.stdout + r.stderr
+
+
+def test_cli_write_baseline_merges_and_preserves_other_sections(tmp_path):
+    import shutil
+    bl = tmp_path / "bl.json"
+    shutil.copy(os.path.join(REPO, "tools", "analysis", "baseline.json"), bl)
+    r = _cli("--passes", "protocol", "--write-baseline",
+             "--baseline", str(bl))
+    assert r.returncode == 0, r.stdout + r.stderr
+    data = json.loads(bl.read_text())
+    assert data["protocol"] == []
+    # sections of passes that did NOT run survive, justifications intact
+    assert data["jax"] and data["sim"]
+    assert all("TODO" not in e["justification"]
+               for e in data["jax"] + data["sim"])
+
+
+def test_cli_exit_2_on_missing_explicit_baseline():
+    r = _cli("--baseline", "tools/analysis/does_not_exist.json")
+    assert r.returncode == 2, r.stdout + r.stderr
+
+
+def test_cli_exit_2_on_internal_error():
+    r = _cli("--baseline", "tools/analysis/does_not_exist.json",
+             "--passes", "nosuchpass")
+    assert r.returncode == 2, r.stdout + r.stderr

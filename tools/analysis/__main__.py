@@ -1,0 +1,90 @@
+"""ouro-lint CLI.
+
+    python -m tools.analysis [--strict] [--passes protocol,jax,sim]
+                             [--baseline PATH | --no-baseline]
+                             [--write-baseline]
+
+Exit codes: 0 clean, 1 non-baselined findings (under --strict also stale
+baseline entries), 2 internal error.  Baselined findings are printed but
+never block.  Runs fully on CPU: the passes are AST walks plus one import
+of the (jax-free) protocols package, so JAX_PLATFORMS=cpu is forced
+before anything else can pull jax in.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# `python -m tools.analysis` from anywhere: make the repo root importable
+# for the protocols import walk.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import argparse  # noqa: E402
+
+from tools.analysis import (  # noqa: E402
+    BASELINE_PATH, Baseline, run_passes,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="ouro-lint: protocol-soundness, JAX-hot-path and "
+                    "sim-determinism static analysis")
+    ap.add_argument("--strict", action="store_true",
+                    help="also fail (exit 1) on stale baseline entries")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated subset of: protocol,jax,sim")
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help=f"baseline file (default {BASELINE_PATH})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: every finding blocks")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(then edit in the justifications)")
+    args = ap.parse_args(argv)
+
+    names = args.passes.split(",") if args.passes else None
+    if args.write_baseline and not os.path.exists(args.baseline):
+        on_disk = Baseline()               # creating a fresh baseline file
+    else:
+        on_disk = Baseline.load(args.baseline)  # typo'd path -> exit 2
+    report = run_passes(names, Baseline() if args.no_baseline else on_disk)
+
+    if args.write_baseline:
+        Baseline.from_findings(report.by_pass, existing=on_disk).dump(
+            args.baseline)
+        print(f"wrote {sum(len(v) for v in report.by_pass.values())} "
+              f"entries to {args.baseline}")
+        return 0
+
+    for f in report.baselined:
+        print(f"baselined: {f.render()}")
+    for f in report.new:
+        print(f.render())
+    for pass_name, key in report.stale:
+        print(f"stale baseline entry [{pass_name}]: {key[0]} {key[1]} "
+              f"[{key[2]}] — finding no longer exists; remove it")
+
+    checked = ", ".join(f"{name}: {len(fs)} finding(s)"
+                        for name, fs in sorted(report.by_pass.items()))
+    print(f"ouro-lint: {checked}; {len(report.new)} blocking, "
+          f"{len(report.baselined)} baselined, {len(report.stale)} stale")
+
+    if report.new:
+        return 1
+    if args.strict and report.stale:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except Exception as e:                      # internal error -> 2
+        print(f"ouro-lint internal error: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        sys.exit(2)

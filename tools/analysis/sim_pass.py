@@ -1,0 +1,126 @@
+"""Pass 3 — sim-determinism lint.
+
+Everything under ouroboros_tpu/ is written against the simharness facade
+and must stay replayable on the deterministic Sim scheduler; only
+simharness/io_runtime.py and network/socket_bearer.py are the declared
+real-IO boundary.  This pass walks every *async* function outside that
+boundary (nested helper defs included — they run on the same cooperative
+scheduler unless explicitly shipped to an executor) and flags operations
+that would block the event loop or smuggle in wall-clock/OS entropy:
+
+- SIM001 real-sleep: time.sleep() stalls the whole cooperative scheduler
+  and reads the real clock; use sim.sleep.
+- SIM002 global-rng: module-global random.*() draws from interpreter-wide
+  state, so interleaving changes results between runs; use a seeded
+  random.Random instance plumbed from the test/sim config (constructing
+  random.Random(seed)/SystemRandom is allowed).
+- SIM003 real-threads: threading.* bypasses the cooperative scheduler
+  entirely; use sim.spawn.
+- SIM004 raw-socket: socket.*() calls are real network IO; use the
+  snocket/bearer abstractions (socket module *constants* are fine).
+- SIM005 blocking-file-io: open()/io.open()/os.open() block the loop; go
+  through storage.fs or the IO runtime's executor.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from . import Finding, register, relpath
+from .astutil import dotted_name, iter_py_files, parse_file
+
+SCAN_DIRS = ("ouroboros_tpu",)
+IO_BOUNDARY = (
+    "ouroboros_tpu/simharness/io_runtime.py",
+    "ouroboros_tpu/network/socket_bearer.py",
+)
+
+_RNG_FACTORIES = {"Random", "SystemRandom"}
+_OPEN_CALLS = {"open", "io.open", "os.open"}
+
+
+class _AsyncBodyLint(ast.NodeVisitor):
+    def __init__(self, file: str):
+        self.file = file
+        self.findings: List[Finding] = []
+        self._stack: List[str] = []
+        self._async_depth = 0
+
+    @property
+    def qualname(self) -> str:
+        return ".".join(self._stack) or "<module>"
+
+    def _visit_scope(self, node, is_async: bool):
+        self._stack.append(node.name)
+        self._async_depth += is_async
+        try:
+            self.generic_visit(node)
+        finally:
+            self._async_depth -= is_async
+            self._stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._visit_scope(node, is_async=False)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_scope(node, is_async=True)
+
+    def visit_ClassDef(self, node):
+        self._stack.append(node.name)
+        try:
+            self.generic_visit(node)
+        finally:
+            self._stack.pop()
+
+    def _add(self, node, rule, message):
+        self.findings.append(Finding(
+            file=self.file, line=node.lineno, rule=rule,
+            symbol=self.qualname, message=message))
+
+    def visit_Call(self, node: ast.Call):
+        if self._async_depth > 0:
+            name = dotted_name(node.func)
+            if name == "time.sleep":
+                self._add(node, "SIM001",
+                          "time.sleep blocks the cooperative scheduler and "
+                          "reads the real clock; use sim.sleep")
+            elif name and name.startswith("random.") and \
+                    name.split(".", 1)[1] not in _RNG_FACTORIES:
+                self._add(node, "SIM002",
+                          f"{name}() uses interpreter-global RNG state; "
+                          f"use a seeded random.Random instance")
+            elif name and name.startswith("threading."):
+                self._add(node, "SIM003",
+                          f"{name}() spawns a real thread outside the "
+                          f"Sim scheduler; use sim.spawn")
+            elif name and name.startswith("socket."):
+                self._add(node, "SIM004",
+                          f"{name}() is real network IO outside the "
+                          f"declared boundary; use snocket/bearer")
+            elif name in _OPEN_CALLS:
+                self._add(node, "SIM005",
+                          f"{name}() is blocking file IO on the "
+                          f"cooperative scheduler; use storage.fs or the "
+                          f"IO runtime executor")
+        self.generic_visit(node)
+
+
+def lint_source(source: str, file: str) -> List[Finding]:
+    """Run the sim pass over one source text (fixture entry point)."""
+    lint = _AsyncBodyLint(file)
+    lint.visit(ast.parse(source, filename=file))
+    return lint.findings
+
+
+def run_files(paths: Iterable[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in paths:
+        lint = _AsyncBodyLint(relpath(path))
+        lint.visit(parse_file(path))
+        findings.extend(lint.findings)
+    return findings
+
+
+@register("sim")
+def run() -> List[Finding]:
+    return run_files(iter_py_files(*SCAN_DIRS, exclude=IO_BOUNDARY))
