@@ -211,15 +211,21 @@ class CpuRefBackend(CryptoBackend):
 
 class OpensslBackend(CpuRefBackend):
     """Ed25519 via OpenSSL (`cryptography`) — the fast-CPU fallback path
-    (the role libsodium plays in the reference deployment)."""
+    (the role libsodium plays in the reference deployment).  Without the
+    binding it degrades to the pure-Python parent (identical verdicts,
+    RFC 8032 is deterministic) so `--backend openssl` stays usable on
+    minimal installs."""
 
     name = "cpu-openssl"
 
     def verify_ed25519_batch(self, reqs):
-        from cryptography.exceptions import InvalidSignature
-        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-            Ed25519PublicKey,
-        )
+        try:
+            from cryptography.exceptions import InvalidSignature
+            from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+                Ed25519PublicKey,
+            )
+        except ImportError:     # absent OR broken binding: degrade
+            return super().verify_ed25519_batch(reqs)
         out = []
         for r in reqs:
             try:
@@ -239,7 +245,9 @@ def default_backend() -> CryptoBackend:
     On the cpu platform (tests / machines without a chip) the JAX kernels
     still work but run the 256-iteration ladders through XLA:CPU at
     seconds per batch — the C-speed OpenSSL path is the right default
-    there, exactly the libsodium-fallback role from BASELINE.json."""
+    there, exactly the libsodium-fallback role from BASELINE.json.
+    Without the `cryptography` binding the pure-Python ground truth is
+    the last resort, so the framework stays functional (just slower)."""
     global _default
     if _default is None:
         try:
@@ -249,7 +257,11 @@ def default_backend() -> CryptoBackend:
             from .jax_backend import JaxBackend
             _default = JaxBackend()
         except Exception:   # no jax / no device: CPU fallback
-            _default = OpensslBackend()
+            import importlib.util
+            if importlib.util.find_spec("cryptography") is not None:
+                _default = OpensslBackend()
+            else:
+                _default = CpuRefBackend()
     return _default
 
 

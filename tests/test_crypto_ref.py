@@ -51,6 +51,8 @@ def test_sign_verify_roundtrip_and_tamper():
 
 
 def test_cross_check_openssl():
+    pytest.importorskip(
+        "cryptography", reason="OpenSSL oracle unavailable")
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey,
     )
@@ -149,8 +151,10 @@ def test_kes_sig_serialisation_roundtrip():
 
 
 def test_backend_batches_agree():
+    import importlib.util
     ref = CpuRefBackend()
-    ssl = OpensslBackend()
+    have_ssl = importlib.util.find_spec("cryptography") is not None
+    ssl = OpensslBackend() if have_ssl else None
     eds, vrfs, kess = [], [], []
     for i in range(4):
         sk = hashlib.sha256(f"b{i}".encode()).digest()
@@ -170,7 +174,8 @@ def test_backend_batches_agree():
     kess.append(KesReq(2, kess[0].vk, 0, kess[0].msg, kess[0].sig_bytes))
     expect_ed = [True] * 4 + [False]
     assert ref.verify_ed25519_batch(eds) == expect_ed
-    assert ssl.verify_ed25519_batch(eds) == expect_ed
     assert ref.verify_vrf_batch(vrfs) == [True] * 4 + [False]
     assert ref.verify_kes_batch(kess) == [True] * 4 + [False]
-    assert ssl.verify_kes_batch(kess) == [True] * 4 + [False]
+    if ssl is not None:                     # OpenSSL leg needs the binding
+        assert ssl.verify_ed25519_batch(eds) == expect_ed
+        assert ssl.verify_kes_batch(kess) == [True] * 4 + [False]

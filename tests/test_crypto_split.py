@@ -162,15 +162,11 @@ def test_y_canonical_mask():
 
 @pytest.mark.device
 def test_split_words_verify_bit_exact_vs_reference():
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
     n = 128
     keys = [hashlib.sha256(b"k%d" % (i % 5)).digest() for i in range(n)]
     vks = [ed25519_ref.public_key(k) for k in keys]
     msgs = [b"m%d" % i for i in range(n)]
-    sigs = [Ed25519PrivateKey.from_private_bytes(k).sign(m)
-            for k, m in zip(keys, msgs)]
+    sigs = [ed25519_ref.sign(k, m) for k, m in zip(keys, msgs)]
     # corruptions: bad sig, bad vk bytes, swapped message
     sigs[3] = sigs[3][:63] + bytes([sigs[3][63] ^ 1])
     vks[5] = b"\xff" * 32
@@ -215,10 +211,6 @@ def test_jax_backend_mixed_window_with_kes_device_hashes():
     KES requests matches the pure-host oracle, including KES signatures
     with tampered hash paths (caught by the device Blake2b batch, not
     host hashing)."""
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
-
     from ouroboros_tpu.crypto import vrf_ref
     from ouroboros_tpu.crypto.backend import (
         CpuRefBackend, Ed25519Req, KesReq, VrfReq,
@@ -226,7 +218,6 @@ def test_jax_backend_mixed_window_with_kes_device_hashes():
     from ouroboros_tpu.crypto.jax_backend import JaxBackend
 
     sk = hashlib.sha256(b"mix-ed").digest()
-    key = Ed25519PrivateKey.from_private_bytes(sk)
     vk = ed25519_ref.public_key(sk)
     vsk = hashlib.sha256(b"mix-vrf").digest()
     vvk = vrf_ref.public_key(vsk)
@@ -236,8 +227,8 @@ def test_jax_backend_mixed_window_with_kes_device_hashes():
     reqs = []
     for i in range(3):
         m = b"e%d" % i
-        reqs.append(Ed25519Req(vk, m, key.sign(m)))
-    reqs.append(Ed25519Req(vk, b"bad", key.sign(b"good")))
+        reqs.append(Ed25519Req(vk, m, ed25519_ref.sign(sk, m)))
+    reqs.append(Ed25519Req(vk, b"bad", ed25519_ref.sign(sk, b"good")))
     for i in range(2):
         a = b"v%d" % i
         reqs.append(VrfReq(vvk, a, vrf_ref.prove(vsk, a)))

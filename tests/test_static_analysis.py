@@ -212,6 +212,20 @@ def test_jax003_numpy_in_jit_fires_transitively():
     assert _rules(f) == {"JAX003"}
 
 
+def test_jax003_nested_def_reported_once():
+    # a def nested in a traced def must yield ONE finding (under the
+    # qualified symbol), not a second copy under its bare name
+    f = jax_lint(
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def outer(x):\n"
+        "    def inner(y):\n"
+        "        return np.sum(y)\n"
+        "    return inner(x)\n", "fx.py")
+    assert [(x.rule, x.symbol) for x in f] == [("JAX003", "outer.inner")]
+
+
 def test_jax003_numpy_outside_jit_is_fine():
     f = jax_lint(
         "import numpy as np\n"

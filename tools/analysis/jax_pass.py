@@ -113,10 +113,12 @@ class _ModuleIndex(ast.NodeVisitor):
         self.traced_lambdas: List[ast.Lambda] = []
         self.calls: Dict[str, Set[str]] = {}   # caller bare name -> callees
         self.jitted_names: Set[str] = set()    # names wrapped by jax.jit
+        self.enclosing: Dict[int, tuple] = {}  # def node id -> outer defs
         self._stack: List[str] = []
 
     def _visit_def(self, node):
         self.defs.setdefault(node.name, []).append(node)
+        self.enclosing[id(node)] = tuple(self._stack)
         if any(_decorator_jits(d) for d in node.decorator_list):
             self.roots.add(node.name)
             self.jitted_names.add(node.name)   # the def IS the jit wrapper
@@ -270,6 +272,12 @@ def _lint_tree(tree: ast.Module, file: str) -> List[Finding]:
     findings: List[Finding] = []
     for name in sorted(traced):
         for node in index.defs.get(name, ()):
+            # a def nested inside a traced def is covered by the outer
+            # walk (symbol `outer.inner`); a standalone walk here would
+            # report the same line twice under two symbols
+            if any(enc in traced
+                   for enc in index.enclosing.get(id(node), ())):
+                continue
             lint = _TracedBodyLint(file, findings, prefix=name)
             for child in ast.iter_child_nodes(node):
                 lint.visit(child)
@@ -277,7 +285,6 @@ def _lint_tree(tree: ast.Module, file: str) -> List[Finding]:
         lint = _TracedBodyLint(file, findings, prefix="<lambda>")
         lint.visit(lam.body)
     _JitPerCallLint(file, findings, index.jitted_names).visit(tree)
-    # a def nested inside another traced def is linted via both subtrees
     return sorted(set(findings))
 
 

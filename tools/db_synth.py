@@ -72,10 +72,6 @@ def open_out_db(fs, args):
 
 
 def synth_mock_praos(args) -> dict:
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
-
     from ouroboros_tpu.consensus.headers import ProtocolBlock, make_header
     from ouroboros_tpu.consensus.protocols.praos import (
         HotKey, Praos, PraosConfig, PraosNode, praos_forge_fields,
@@ -97,7 +93,6 @@ def synth_mock_praos(args) -> dict:
     kes_vks = [kes_mod.vk_of(args.kes_depth, s) for s in kes_seeds]
     pay_sks = [h(b"pay", i) for i in range(n)]
     pay_vks = [ed25519_ref.public_key(sk) for sk in pay_sks]
-    ssl_keys = [Ed25519PrivateKey.from_private_bytes(sk) for sk in pay_sks]
 
     cfg = PraosConfig(
         nodes=tuple(PraosNode(vrf_vks[i], kes_vks[i], 1) for i in range(n)),
@@ -160,7 +155,7 @@ def synth_mock_praos(args) -> dict:
                 continue
             txid, ix, amount = spendable[owner].pop(0)
             tx = Tx((TxIn(txid, ix),), (TxOut(pay_vks[owner], amount),))
-            sig = ssl_keys[owner].sign(tx.txid)
+            sig = ed25519_ref.sign(pay_sks[owner], tx.txid)
             tx = Tx(tx.inputs, tx.outputs, ((pay_vks[owner], sig),))
             spendable[owner].append((tx.txid, 0, amount))
             body.append(tx)

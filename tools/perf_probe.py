@@ -52,9 +52,6 @@ def main():
     import numpy as np
 
     import jax.numpy as jnp
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-        Ed25519PrivateKey,
-    )
 
     from ouroboros_tpu.crypto import ed25519_jax as EJ
     from ouroboros_tpu.crypto import ed25519_ref, vrf_ref
@@ -63,10 +60,9 @@ def main():
 
     n = args.n_ed
     sk = hashlib.sha256(b"probe").digest()
-    key = Ed25519PrivateKey.from_private_bytes(sk)
     vk = ed25519_ref.public_key(sk)
     msgs = [b"m%06d" % i for i in range(n)]
-    sigs = [key.sign(m) for m in msgs]
+    sigs = [ed25519_ref.sign(sk, m) for m in msgs]
 
     # --- Ed25519 split/words (production): e2e incl. host prep
     def run_split_e2e():
