@@ -104,6 +104,14 @@ class PeerGSVTracker:
         s = min(inn.s, s_sample) if self._rtt_count else s_sample
         self.gsv = PeerGSV(self.gsv.outbound, replace(inn, s=s))
 
+    @property
+    def measured(self) -> bool:
+        """True once ANY real sample (RTT probe or SDU one-way delay)
+        landed — before that the GSV is the optimistic default and must
+        not be used to set deadlines (an unmeasured peer would get an
+        impossibly tight watchdog)."""
+        return self._rtt_count > 0 or self._owd_count > 0
+
     def expected_fetch_time(self, nbytes: int,
                             req_bytes: int = 100) -> float:
         return self.gsv.request_response_duration(req_bytes, nbytes)

@@ -66,18 +66,32 @@ def eval_error_policies(policies: Sequence[ErrorPolicy],
     return None
 
 
-def default_node_policies() -> list[ErrorPolicy]:
+def default_node_policies(violation: float = 200.0,
+                          transport: float = 20.0,
+                          unknown: float = 60.0) -> list[ErrorPolicy]:
     """The consensus-flavoured defaults (Node/ErrorPolicy.hs): protocol
     violations and validation failures suspend the peer for a long time;
     transport hiccups suspend briefly; everything unknown suspends
-    conservatively."""
+    conservatively.  The three duration knobs exist so sim/chaos harnesses
+    can scale the windows to sim time while exercising the SAME policy
+    set (testing a hand-copied list would let the two drift)."""
     from ..node.chain_sync import ChainSyncClientError
+    from ..node.watchdog import WatchdogTimeout
+    from .mux import MuxError
     from .typed import ProtocolError
     from ..network.protocols.codec import CodecError
     return [
-        ErrorPolicy(ChainSyncClientError, lambda e: suspend_peer(200.0)),
-        ErrorPolicy(ProtocolError, lambda e: suspend_peer(200.0)),
-        ErrorPolicy(CodecError, lambda e: suspend_peer(200.0)),
-        ErrorPolicy(ConnectionError, lambda e: suspend_consumer(20.0)),
-        ErrorPolicy(Exception, lambda e: suspend_consumer(60.0)),
+        ErrorPolicy(ChainSyncClientError,
+                    lambda e: suspend_peer(violation)),
+        ErrorPolicy(ProtocolError, lambda e: suspend_peer(violation)),
+        ErrorPolicy(CodecError, lambda e: suspend_peer(violation)),
+        # a peer silent past its per-state time limit is likely overloaded
+        # or partitioned, not hostile: brief consumer-side suspension, then
+        # redial (the reference's shortDelay for timeout errors)
+        ErrorPolicy(WatchdogTimeout, lambda e: suspend_consumer(transport)),
+        # the mux died under the protocol (bearer EOF / poisoned teardown
+        # after a watchdog kill): transport-level hiccup, brief suspension
+        ErrorPolicy(MuxError, lambda e: suspend_consumer(transport)),
+        ErrorPolicy(ConnectionError, lambda e: suspend_consumer(transport)),
+        ErrorPolicy(Exception, lambda e: suspend_consumer(unknown)),
     ]

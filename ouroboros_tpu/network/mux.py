@@ -135,10 +135,15 @@ class MuxChannel:
         return out
 
     async def wait_ready(self, timeout: float) -> bool:
-        """True when ingress bytes are pending, False after `timeout` —
-        non-destructive (see Channel.wait_ready)."""
+        """True when ingress bytes are pending OR the mux died, False
+        after `timeout` — non-destructive (see Channel.wait_ready).
+        Reporting a dead mux as ready matters for the watchdog path: the
+        caller's follow-up recv() raises MuxError NOW, instead of a
+        transport death masquerading as peer silence for the remainder of
+        the state's time limit."""
         return await sim.wait_pred(
-            lambda tx: bool(tx.read(self.ingress)), timeout)
+            lambda tx: bool(tx.read(self.ingress))
+            or tx.read(self._mux._closed), timeout)
 
     async def try_recv(self) -> bytes:
         """Drain pending ingress bytes without blocking (b"" when none)."""
@@ -215,7 +220,23 @@ class Mux:
 
     async def _egress_loop(self):
         """Round-robin over channels; one SDU per channel per cycle
-        (Egress.hs:77-105 fairness)."""
+        (Egress.hs:77-105 fairness).  A bearer-write death (EOF or an
+        injected LinkDown) poisons the channels exactly like a demux-side
+        death — otherwise senders block on full egress TVars and a
+        transport death masquerades as peer silence until a watchdog
+        notices."""
+        try:
+            await self._egress_body()
+        except sim.AsyncCancelled:
+            self._mark_closed()
+            raise
+        except BaseException as exc:
+            sim.trace_event((self.label, "bearer-died", repr(exc)),
+                            label="mux")
+            self._mark_closed()
+            raise
+
+    async def _egress_body(self):
         while True:
             # wait until any channel has egress data; reading _chan_version
             # inside the transaction adds it to the retry read set, so late
@@ -248,7 +269,15 @@ class Mux:
         threads blocked in recv/send fail rather than hang."""
         try:
             await self._demux_body()
-        except BaseException:
+        except sim.AsyncCancelled:
+            self._mark_closed()
+            raise
+        except BaseException as exc:
+            # bearer death (incl. injected LinkDown) is a recovery-relevant
+            # event: make the teardown reason visible in the sim trace so a
+            # chaos run is debuggable from the trace alone
+            sim.trace_event((self.label, "bearer-died", repr(exc)),
+                            label="mux")
             self._mark_closed()
             raise
 
@@ -331,4 +360,11 @@ class CodecChannel:
             remaining = deadline - sim.now()
             if remaining <= 0 or not await self._ch.wait_ready(remaining):
                 return False
-            self._buf += await self._ch.try_recv()
+            got = await self._ch.try_recv()
+            if not got:
+                # ready with nothing pending = the byte channel closed
+                # underneath: report ready so the caller's recv() raises
+                # the MuxError now (also avoids a livelock re-polling a
+                # permanently-ready dead channel)
+                return True
+            self._buf += got

@@ -73,15 +73,29 @@ async def server(session):
 
 
 async def client_probe(session, rounds, interval: float,
-                       on_rtt=None):
+                       on_rtt=None, response_timeout=None):
     """Probe loop: send cookie, measure virtual RTT, report to on_rtt
     (the DeltaQ feed, KeepAlive.hs:41-55).  rounds=None probes forever
-    (the node's long-lived keep-alive)."""
+    (the node's long-lived keep-alive).
+
+    response_timeout: the per-reply watchdog (timeLimitsKeepAlive, 60 s in
+    the reference) — a responder silent past it raises KeepAliveTimeout,
+    the whole-connection liveness verdict the kernel converts into a mux
+    teardown.  The wait is a non-destructive wait_ready poll, so the
+    timeout path consumes nothing."""
     rtts = []
     cookie = 0
     while rounds is None or cookie < rounds:
         t0 = sim.now()
         await session.send(MsgKeepAlive(cookie & 0xFFFF))
+        if response_timeout is not None:
+            ready = await session.channel.wait_ready(response_timeout)
+            if not ready:
+                from ...node.watchdog import KeepAliveTimeout
+                sim.trace_event(("timeout", "keep-alive", "KAServer",
+                                 cookie), label="watchdog")
+                raise KeepAliveTimeout("keep-alive", "KAServer",
+                                       response_timeout)
         reply = await session.recv()
         if reply.cookie != cookie & 0xFFFF:
             raise RuntimeError("keep-alive cookie mismatch")

@@ -13,11 +13,13 @@ a socket Snocket plugs into the same seam).
 """
 from __future__ import annotations
 
+import hashlib
+import random
 from dataclasses import dataclass
 from typing import Callable, Dict, Optional, Sequence
 
 from .. import simharness as sim
-from .error_policy import ErrorPolicy, eval_error_policies
+from .error_policy import ErrorPolicy, SuspendDecision, eval_error_policies
 
 
 class Resolver:
@@ -145,10 +147,28 @@ async def dns_subscription_targets(resolver: Resolver, names: Sequence[str],
 
 @dataclass
 class PeerState:
-    """Subscription/PeerState.hs: per-address dial bookkeeping."""
+    """Subscription/PeerState.hs: per-address dial bookkeeping.
+
+    The two suspension clocks mirror the reference's SuspendDecision
+    split: `consumer_until` blocks only OUR outbound dialling
+    (suspend-consumer — the peer's inbound service to us may be fine),
+    `peer_until` marks the peer bad in both directions (suspend-peer —
+    protocol violation / invalid data; an accept path can consult
+    `peer_suspended`)."""
     fail_count: int = 0
-    suspended_until: float = 0.0
+    consumer_until: float = 0.0
+    peer_until: float = 0.0
     connected: bool = False
+
+    @property
+    def suspended_until(self) -> float:
+        return max(self.consumer_until, self.peer_until)
+
+
+class SubscriptionFatal(Exception):
+    """A THROW verdict: the error policy classified the failure as fatal
+    to the application, not to the one peer (ErrorPolicy.hs `Throw`).
+    Carries the original exception as __cause__."""
 
 
 class SubscriptionWorker:
@@ -156,18 +176,35 @@ class SubscriptionWorker:
 
     dial(addr) -> Async handle whose completion (normal or exceptional)
     means the connection ended.  Failures are classified by the error
-    policies into suspension windows before the address is redialled.
+    policies into SuspendDecision verdicts (Worker.hs + PeerState.hs):
+
+    - throw             -> SubscriptionFatal out of run() (application dies)
+    - suspend-peer      -> both-direction suspension, exponential backoff
+    - suspend-consumer  -> dial-side suspension, exponential backoff
+    - clean end         -> fail_count RESET, one base_backoff churn pause
+                           (a successful session wipes the escalation —
+                           the reference re-zeroes the peer state when a
+                           connection completes without error)
+
+    Backoff is `duration * 2^min(fail_count-1, 5)` plus seeded jitter so
+    a fleet of workers never thundering-herds a recovering peer — and the
+    jitter comes from a per-worker blake2b-seeded RNG, keeping whole-sim
+    replays byte-identical.
     """
 
     def __init__(self, targets: Sequence, valency: int,
                  dial: Callable, error_policies: Sequence[ErrorPolicy] = (),
-                 base_backoff: float = 5.0, label: str = "subscription"):
+                 base_backoff: float = 5.0, label: str = "subscription",
+                 jitter: float = 0.25, seed: int = 0):
         self.targets = list(targets)
         self.valency = min(valency, len(self.targets))
         self.dial = dial
         self.error_policies = list(error_policies)
         self.base_backoff = base_backoff
         self.label = label
+        self.jitter = jitter
+        h = hashlib.blake2b(f"{seed}:{label}".encode(), digest_size=8)
+        self.rng = random.Random(int.from_bytes(h.digest(), "big"))
         self.states: Dict[object, PeerState] = {
             a: PeerState() for a in self.targets}
         self.trace: list = []
@@ -179,18 +216,49 @@ class SubscriptionWorker:
                 if not self.states[a].connected
                 and self.states[a].suspended_until <= now]
 
+    def peer_suspended(self, addr) -> bool:
+        """True while `addr` sits in a suspend-peer window — the signal an
+        accept/server path can consult to refuse the peer's inbound too."""
+        st = self.states.get(addr)
+        return st is not None and st.peer_until > sim.now()
+
+    def _backoff(self, duration: float, fail_count: int) -> float:
+        scaled = duration * (2 ** min(max(fail_count - 1, 0), 5))
+        return scaled * (1.0 + self.rng.random() * self.jitter)
+
     def _on_conn_end(self, addr, exc: Optional[BaseException]) -> None:
         st = self.states[addr]
         st.connected = False
-        if exc is not None:
-            verdict = eval_error_policies(self.error_policies, exc)
-            dur = verdict.duration if verdict is not None \
-                else self.base_backoff
-        else:
-            dur = self.base_backoff
+        now = sim.now()
+        if exc is None:
+            # clean session: reset the escalation entirely; pause one
+            # base_backoff (no exponent) before re-dialling so a cleanly
+            # churning peer is not hammered — but never escalates either
+            st.fail_count = 0
+            st.consumer_until = now + self._backoff(self.base_backoff, 0)
+            self.trace.append((now, "conn-end", addr, None))
+            sim.trace_event((self.label, "conn-end-clean", addr),
+                            label="subscription")
+            return
+        verdict = eval_error_policies(self.error_policies, exc)
+        if verdict is None:
+            verdict = SuspendDecision("suspend-consumer", self.base_backoff)
+        if verdict.kind == "throw":
+            # fatal: surface to the application instead of converting the
+            # verdict into a quiet backoff window
+            sim.trace_event((self.label, "fatal", addr, repr(exc)),
+                            label="subscription")
+            raise SubscriptionFatal(
+                f"{self.label}: THROW verdict for {addr}") from exc
         st.fail_count += 1
-        st.suspended_until = sim.now() + dur * (2 ** min(st.fail_count, 5))
-        self.trace.append((sim.now(), "conn-end", addr, repr(exc)))
+        until = now + self._backoff(verdict.duration, st.fail_count)
+        st.consumer_until = max(st.consumer_until, until)
+        if verdict.kind == "suspend-peer":
+            st.peer_until = max(st.peer_until, until)
+        self.trace.append((now, "conn-end", addr, repr(exc)))
+        sim.trace_event((self.label, "suspend", addr, verdict.kind,
+                         round(until - now, 6), st.fail_count),
+                        label="subscription")
 
     async def run(self) -> None:
         """subscriptionLoop: top up to valency, then block until a
@@ -217,6 +285,8 @@ class SubscriptionWorker:
                 st = self.states[addr]
                 st.connected = True
                 self.trace.append((sim.now(), "dial", addr))
+                sim.trace_event((self.label, "dial", addr, st.fail_count),
+                                label="subscription")
                 handle = self.dial(addr)
                 self._conns[addr] = handle
                 sim.spawn(watch(addr, handle),

@@ -325,13 +325,25 @@ async def block_fetch_client(session, kernel, peer_id) -> None:
     On any failure the peer's in-flight claims are released and the peer is
     dropped from fetch consideration — otherwise its claimed hashes would
     block every other peer from ever re-requesting that chain segment."""
+    from .watchdog import WatchdogTimeout
     ps = kernel.peer_fetch[peer_id]
     try:
         while True:
             req = await sim.atomically(lambda tx: ps.queue.get(tx))
             try:
                 t0 = sim.now()
-                blocks = await fetch_range(session, req.start, req.end)
+                # whole-request watchdog (timeLimitsBlockFetch), tightened
+                # by the peer's DeltaQ estimate: a measured-fast peer gets
+                # a measured-fast deadline instead of the 60s ceiling
+                deadline = kernel.time_limits.fetch_deadline(
+                    kernel.peer_gsv.get(peer_id),
+                    max(req.est_bytes, ps.avg_block_bytes))
+                done, blocks = await sim.timeout(
+                    deadline, fetch_range(session, req.start, req.end))
+                if not done:
+                    sim.trace_event(("timeout", "block-fetch", "BFBusy",
+                                     peer_id), label="watchdog")
+                    raise WatchdogTimeout("block-fetch", "BFBusy", deadline)
                 tracker = kernel.peer_gsv.get(peer_id)
                 if blocks:
                     total = sum(len(b.bytes) for b in blocks)

@@ -28,6 +28,7 @@ from ..network.protocols.chainsync import (
     MsgRequestNext, MsgRollBackward, MsgRollForward,
 )
 from ..simharness import Retry, TVar
+from .watchdog import collect_with_limit, recv_with_limit
 
 # Fibonacci-ish offsets for intersection points, like the reference's
 # chainSyncClient headerPoints (Client.hs mkPoints)
@@ -77,13 +78,16 @@ async def chain_sync_client(session, kernel, candidate: CandidateState,
     """
     db = kernel.chain_db
     protocol = kernel.protocol
+    # per-state time limits (timeLimitsChainSync): a peer silent past its
+    # state's deadline is killed via WatchdogTimeout -> ErrorPolicy
+    limits = kernel.time_limits.chain_sync()
 
     # -- find intersection with our current chain ----------------------------
     points = db.current_chain.select_points(_OFFSETS)
     if db.current_chain.anchor not in points:
         points.append(db.current_chain.anchor)
     await session.send(MsgFindIntersect(tuple(points)))
-    reply = await session.recv()
+    reply = await recv_with_limit(session, limits, peer_id=candidate.peer_id)
     if isinstance(reply, MsgIntersectNotFound):
         raise ChainSyncClientError("no intersection with peer chain")
     assert isinstance(reply, MsgIntersectFound)
@@ -177,7 +181,8 @@ async def chain_sync_client(session, kernel, candidate: CandidateState,
             if not ready:
                 flush()
                 continue
-        msg = await session.collect()
+        msg = await collect_with_limit(session, limits,
+                                       peer_id=candidate.peer_id)
         if isinstance(msg, MsgAwaitReply):
             # caught up: validate what we have, then wait for the next
             # server push (the collect below blocks on the channel)

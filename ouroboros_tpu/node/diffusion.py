@@ -30,7 +30,8 @@ from ..network.peer_selection import (
     PeerSelectionActions, PeerSelectionGovernor, PeerSelectionTargets,
 )
 from ..network.subscription import (
-    Resolver, SubscriptionWorker, dns_subscription_targets,
+    Resolver, SubscriptionFatal, SubscriptionWorker,
+    dns_subscription_targets,
 )
 from .kernel import NodeKernel, _connect_directional, _run_initiator, \
     _run_responder
@@ -175,7 +176,8 @@ async def run_data_diffusion(kernel: NodeKernel, args: DiffusionArguments,
             label=f"{kernel.label}-ip-subscription")
         d.workers.append(w)
         d.threads.append(sim.spawn(
-            w.run(), label=f"{kernel.label}-ip-subscription"))
+            _run_subscription(w, kernel),
+            label=f"{kernel.label}-ip-subscription"))
 
     # -- DNS subscription workers (Diffusion.hs:220)
     for name in args.dns_producers:
@@ -189,12 +191,30 @@ async def run_data_diffusion(kernel: NodeKernel, args: DiffusionArguments,
                 error_policies=policies,
                 label=f"{kernel.label}-dns-{name}")
             d.workers.append(w)
-            await w.run()
+            await _run_subscription(w, kernel)
         d.threads.append(sim.spawn(
             dns_worker(), label=f"{kernel.label}-dns-subscription-{name}"))
 
     kernel._threads.extend(d.threads)
     return d
+
+
+async def _run_subscription(worker: SubscriptionWorker,
+                            kernel: NodeKernel) -> None:
+    """Run a subscription worker under the THROW contract: a
+    SubscriptionFatal verdict is fatal to the APPLICATION, not just the
+    one peer (ErrorPolicy.hs `Throw`), so the whole node is stopped
+    visibly — without this the worker thread dies silently reaped and the
+    kernel keeps running with its connections never replenished."""
+    try:
+        await worker.run()
+    except SubscriptionFatal as exc:
+        sim.trace_event((kernel.label, "diffusion-fatal", repr(exc)),
+                        label="subscription")
+        try:
+            raise
+        finally:
+            kernel.stop()
 
 
 async def connect_local_client_via(snocket: Snocket, addr, kernel_info,
@@ -257,12 +277,21 @@ async def connect_local_client_via(snocket: Snocket, addr, kernel_info,
 
 class SimNetwork:
     """Address registry standing in for the Snocket layer: maps addresses
-    to listening kernels and dials by spawning directional connections."""
+    to listening kernels and dials by spawning directional connections.
 
-    def __init__(self, link_delay: float = 0.05, sdu_size: int = 12288):
+    fault_plan: a simharness FaultPlan applied to every dialled
+    connection's bearers — AND to the dial itself: dialling across an
+    active partition is refused (the TCP-SYN-times-out analog), so
+    suspension/redial cycles run at backoff speed instead of waiting out
+    a full handshake watchdog."""
+
+    def __init__(self, link_delay: float = 0.05, sdu_size: int = 12288,
+                 fault_plan=None):
         self.link_delay = link_delay
         self.sdu_size = sdu_size
+        self.fault_plan = fault_plan
         self.listeners: Dict[object, NodeKernel] = {}
+        self._dial_seq: Dict[tuple, int] = {}
 
     def listen(self, addr, kernel: NodeKernel) -> None:
         self.listeners[addr] = kernel
@@ -274,8 +303,21 @@ class SimNetwork:
                 async def fail():
                     raise ConnectionError(f"no listener at {addr}")
                 return sim.spawn(fail(), label=f"dial-fail-{addr}")
+            if self.fault_plan is not None and \
+                    self.fault_plan.partition_severs(kernel.label,
+                                                     target.label):
+                async def refused():
+                    sim.trace_event(("dial-refused-partition", kernel.label,
+                                     target.label), label="fault")
+                    raise ConnectionError(
+                        f"partitioned: {kernel.label}->{target.label}")
+                return sim.spawn(refused(), label=f"dial-part-{addr}")
+            key = (kernel.label, target.label)
+            seq = self._dial_seq[key] = self._dial_seq.get(key, 0) + 1
             return _connect_directional(kernel, target,
-                                        self.link_delay, self.sdu_size)
+                                        self.link_delay, self.sdu_size,
+                                        fault_plan=self.fault_plan,
+                                        conn_seq=seq)
         return dial
 
 
@@ -458,7 +500,8 @@ def run_governed_diffusion(kernel: NodeKernel, network: SimNetwork,
 
 def run_sim_diffusion(kernel: NodeKernel, network: SimNetwork,
                       address, ip_targets=(), valency: int = 2,
-                      error_policies=None) -> Diffusion:
+                      error_policies=None, base_backoff: float = 5.0,
+                      seed: int = 0) -> Diffusion:
     """SimNetwork-based composition (the pre-round-4 surface)."""
     network.listen(address, kernel)
     d = Diffusion()
@@ -468,8 +511,10 @@ def run_sim_diffusion(kernel: NodeKernel, network: SimNetwork,
             dial=network.make_dial(kernel),
             error_policies=(error_policies if error_policies is not None
                             else default_node_policies()),
+            base_backoff=base_backoff, seed=seed,
             label=f"{kernel.label}-subscription")
-        t = sim.spawn(worker.run(), label=f"{kernel.label}-subscription")
+        t = sim.spawn(_run_subscription(worker, kernel),
+                      label=f"{kernel.label}-subscription")
         kernel._threads.append(t)
         d.workers.append(worker)
         d.threads.append(t)

@@ -36,6 +36,7 @@ from .blockchain_time import BlockchainTime
 from .chain_sync import CandidateState, chain_sync_client, chain_sync_server
 from .tx_submission import (TxInboundProtocolError, tx_inbound_loop,
                             tx_outbound_loop)
+from .watchdog import KeepAliveTimeout, NodeTimeLimits, WatchdogTimeout
 
 # protocol numbers per NodeToNode.hs:211-212 (handshake=0, chainsync=2,
 # blockfetch=3, txsubmission=4, keepalive=8)
@@ -59,7 +60,7 @@ class NodeKernel:
                  btime: BlockchainTime, forgings=(), label: str = "node",
                  backend=None, chain_sync_window: int = 32,
                  header_decode=None, block_decode_obj=None, tx_decode=None,
-                 tracers=None):
+                 tracers=None, time_limits: Optional[NodeTimeLimits] = None):
         from ..utils.tracer import NodeTracers
         self.chain_db = chain_db
         self.ledger_rules = ledger_rules
@@ -80,6 +81,9 @@ class NodeKernel:
         self.peer_fetch: Dict[object, PeerFetchState] = {}
         self.peer_gsv: Dict[object, PeerGSVTracker] = {}
         self.keepalive_interval = 10.0
+        # per-state protocol watchdogs (timeLimits*; node/watchdog.py)
+        self.time_limits = time_limits if time_limits is not None \
+            else NodeTimeLimits()
         self.network_magic = 0
         self.fetch_wakeup = TVar(0, label=f"{label}-fetch-wakeup")
         self._fetch_v = 0
@@ -271,40 +275,58 @@ class NodeKernel:
 
 
 def connect_nodes(a: NodeKernel, b: NodeKernel, delay: float = 0.0,
-                  sdu_size: int = 12288) -> None:
+                  sdu_size: int = 12288, fault_plan=None) -> None:
     """Wire a<->b with two directional connections (the ThreadNet mesh edge,
     Test/ThreadNet/Network.hs:275-344): each direction runs its own bearer,
-    mux, and initiator/responder protocol set."""
-    _connect_directional(a, b, delay, sdu_size)
-    _connect_directional(b, a, delay, sdu_size)
+    mux, and initiator/responder protocol set.  A FaultPlan wraps every
+    bearer so the whole mesh runs under seeded network hostility."""
+    _connect_directional(a, b, delay, sdu_size, fault_plan=fault_plan)
+    _connect_directional(b, a, delay, sdu_size, fault_plan=fault_plan)
 
 
 def _connect_directional(initiator: NodeKernel, responder: NodeKernel,
-                         delay: float, sdu_size: int):
+                         delay: float, sdu_size: int, fault_plan=None,
+                         conn_seq: int = 0):
     """initiator runs chainsync/blockfetch clients against responder's
     servers (learning responder's chain) and offers its txs to responder's
     inbound (NodeToNode.hs initiator/responder application split).
 
     Version negotiation runs FIRST, on protocol 0 over the same bearer, and
     only a successful handshake starts the mini-protocols (Socket.hs:226:
-    negotiate-then-multiplex)."""
+    negotiate-then-multiplex).
+
+    fault_plan: a simharness FaultPlan wrapping both bearers (each write
+    direction draws from its own seeded stream).  conn_seq distinguishes
+    successive redials of the same edge in thread labels."""
     peer_id = f"{initiator.label}->{responder.label}"
+    tag = f"{peer_id}#{conn_seq}" if conn_seq else peer_id
     bi, br = bearer_pair(sdu_size=sdu_size, delay=delay)
+    if fault_plan is not None:
+        bi = fault_plan.wrap_bearer(bi, initiator.label, responder.label)
+        br = fault_plan.wrap_bearer(br, responder.label, initiator.label)
     # the initiator's GSV estimate for this peer is fed passively by the
     # demuxer's per-SDU one-way delays (TraceStats.hs) on top of the
     # KeepAlive RTT probes
     tracker = PeerGSVTracker()
-    mux_i = Mux(bi, f"{peer_id}.mux-i", owd_observer=tracker.observe_owd)
-    mux_r = Mux(br, f"{peer_id}.mux-r")
+    mux_i = Mux(bi, f"{tag}.mux-i", owd_observer=tracker.observe_owd)
+    mux_r = Mux(br, f"{tag}.mux-r")
     mux_i.start()
     mux_r.start()
 
-    handle = sim.spawn(_run_initiator(initiator, mux_i, peer_id, tracker),
-                       label=f"{peer_id}.connect-i")
+    async def run_and_teardown():
+        # the dial-path contract (matching diffusion._dialer): when the
+        # initiator application ends — cleanly or by a kill — its mux dies
+        # with it, so redials never talk over a poisoned half-open bearer
+        try:
+            await _run_initiator(initiator, mux_i, peer_id, tracker)
+        finally:
+            mux_i.stop()
+
+    handle = sim.spawn(run_and_teardown(), label=f"{tag}.connect-i")
     initiator._threads.append(handle)
     responder._threads.append(sim.spawn(
         _run_responder(responder, mux_r, peer_id),
-        label=f"{peer_id}.connect-r"))
+        label=f"{tag}.connect-r"))
     return handle
 
 
@@ -333,16 +355,33 @@ async def _initiator_handshake(initiator: NodeKernel, mux_i, peer_id):
 
 def _start_keepalive(initiator: NodeKernel, mux_i, peer_id, tracker):
     """The WARM-stage protocol (the reference keeps KeepAlive running on
-    warm peers): RTT probes feeding the peer's GSV tracker."""
+    warm peers): RTT probes feeding the peer's GSV tracker.
+
+    The probe doubles as the whole-connection liveness watchdog
+    (timeLimitsKeepAlive): a responder silent past the reply deadline
+    raises KeepAliveTimeout, and the supervisor tears the mux down —
+    poisoning every mini-protocol channel so the hot set dies with
+    MuxError instead of hanging, which ends the connection and feeds the
+    failure to the error-policy/reconnect layer."""
     initiator.peer_gsv[peer_id] = tracker
     ka_sess = Session(
         ka_proto.SPEC, CLIENT,
         CodecChannel(mux_i.channel(KEEPALIVE_NUM, INITIATOR),
                      ka_proto.CODEC))
-    return sim.spawn(
-        ka_proto.client_probe(ka_sess, None, initiator.keepalive_interval,
-                              on_rtt=tracker.observe_rtt),
-        label=f"{peer_id}.ka-client")
+
+    async def supervised():
+        try:
+            await ka_proto.client_probe(
+                ka_sess, None, initiator.keepalive_interval,
+                on_rtt=tracker.observe_rtt,
+                response_timeout=initiator.time_limits.keep_alive_timeout)
+        except KeepAliveTimeout:
+            sim.trace_event(("keepalive-kill", initiator.label, peer_id),
+                            label="watchdog")
+            mux_i.stop()
+            raise
+
+    return sim.spawn(supervised(), label=f"{peer_id}.ka-client")
 
 
 async def _run_hot(initiator: NodeKernel, mux_i, peer_id, version) -> None:
@@ -362,7 +401,9 @@ async def _run_hot(initiator: NodeKernel, mux_i, peer_id, version) -> None:
         bf_proto.SPEC, CLIENT,
         CodecChannel(mux_i.channel(BLOCKFETCH_NUM, INITIATOR), bf_codec))
     satellites.append(sim.spawn(
-        block_fetch_client(bf_sess, initiator, peer_id),
+        _supervise_block_fetch(
+            block_fetch_client(bf_sess, initiator, peer_id),
+            initiator, mux_i, peer_id),
         label=f"{peer_id}.bf-client"))
 
     if initiator.mempool is not None and version >= n2n.NODE_TO_NODE_V2:
@@ -396,7 +437,18 @@ async def _run_initiator(initiator: NodeKernel, mux_i, peer_id,
     Client.hs kill semantics); satellite protocols are cancelled on exit
     so subscription workers can treat completion as connection-down and
     redial."""
-    version = await _initiator_handshake(initiator, mux_i, peer_id)
+    # the whole negotiation runs under one deadline (the reference's
+    # handshake timeout): a peer that swallows the proposal would
+    # otherwise hang this dial forever while it holds a valency slot
+    done, version = await sim.timeout(
+        initiator.time_limits.handshake_timeout,
+        _initiator_handshake(initiator, mux_i, peer_id))
+    if not done:
+        sim.trace_event(("timeout", "handshake", "StConfirm", peer_id),
+                        label="watchdog")
+        mux_i.stop()
+        raise WatchdogTimeout("handshake", "StConfirm",
+                              initiator.time_limits.handshake_timeout)
     if version is None:
         return
     tracker = tracker if tracker is not None else PeerGSVTracker()
@@ -476,11 +528,29 @@ async def _supervise_tx(coro, kernel, mux, peer_id) -> None:
         mux.stop()
 
 
+async def _supervise_block_fetch(coro, kernel, mux, peer_id) -> None:
+    """Observe the BlockFetch client: a watchdog-expired request means the
+    peer is silent past its (DeltaQ-informed) deadline — kill the whole
+    connection via mux teardown, same as the reference's per-protocol time
+    limits feeding the connection-level error path."""
+    from .watchdog import WatchdogTimeout
+    try:
+        await coro
+    except WatchdogTimeout:
+        sim.trace_event(("block-fetch-watchdog-kill", kernel.label,
+                         peer_id), label="watchdog")
+        mux.stop()
+
+
 async def _supervise_chain_sync(kernel: NodeKernel, session, candidate,
                                 peer_id) -> None:
     """Run the ChainSync client; on error drop the peer's candidate so
     BlockFetch stops considering it (the kill-the-connection semantics of
-    Client.hs:1114, minus reconnection policy)."""
+    Client.hs:1114), then RE-RAISE so the connection ends exceptionally:
+    the reconnect layer's ErrorPolicy must see the violation and suspend
+    the peer — swallowing it here would make the failure look like a
+    clean session end (fail_count reset + base backoff) and the node
+    would churn against a protocol-violating peer forever."""
     from .chain_sync import ChainSyncClientError
     try:
         await chain_sync_client(session, kernel, candidate,
@@ -488,3 +558,4 @@ async def _supervise_chain_sync(kernel: NodeKernel, session, candidate,
     except ChainSyncClientError as e:
         sim.trace_event(("chain-sync-kill", kernel.label, peer_id, str(e)))
         kernel.drop_peer(peer_id)
+        raise

@@ -28,12 +28,17 @@ from .core import (
     trace_event as _sim_trace_event,
     yield_ as _sim_yield,
 )
+from .faults import (
+    FaultPlan, FaultSpec, FaultyBearer, FaultyChannel, LinkDown, Partition,
+)
 from .io_runtime import IoAsync, IoRuntime, io_run
 from .stm import Retry, TBQueue, TMVar, TQueue, TVar, Tx, retry
 
 __all__ = [
     "Async", "AsyncCancelled", "Deadlock", "Sim", "SimEvent", "Trace",
     "IoAsync", "IoRuntime", "io_run",
+    "FaultPlan", "FaultSpec", "FaultyBearer", "FaultyChannel", "LinkDown",
+    "Partition",
     "atomically", "current_sim", "mask", "new_timeout", "now", "run",
     "run_trace", "sleep", "spawn", "timeout", "trace_event", "yield_",
     "Retry", "TBQueue", "TMVar", "TQueue", "TVar", "Tx", "retry",

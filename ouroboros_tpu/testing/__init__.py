@@ -2,12 +2,16 @@
 
 ThreadNet (multi-node network-in-the-simulator) lives here so test suites
 and benchmarks share one harness (reference: ouroboros-consensus-test/src/
-Test/ThreadNet/{General,Network}.hs).
+Test/ThreadNet/{General,Network}.hs).  The chaos layer runs the same
+network under a seeded FaultPlan with subscription-based recovery.
 """
 from .threadnet import (
-    PraosNetworkFactory, ThreadNetConfig, ThreadNetResult, praos_node_keys,
-    run_threadnet,
+    ChaosConfig, ChaosResult, PraosNetworkFactory, ThreadNetConfig,
+    ThreadNetResult, chaos_error_policies, chaos_time_limits,
+    praos_node_keys, run_chaos_threadnet, run_threadnet,
 )
 
-__all__ = ["PraosNetworkFactory", "ThreadNetConfig", "ThreadNetResult",
-           "praos_node_keys", "run_threadnet"]
+__all__ = ["ChaosConfig", "ChaosResult", "PraosNetworkFactory",
+           "ThreadNetConfig", "ThreadNetResult", "chaos_error_policies",
+           "chaos_time_limits", "praos_node_keys", "run_chaos_threadnet",
+           "run_threadnet"]

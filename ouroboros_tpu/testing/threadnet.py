@@ -368,3 +368,189 @@ def run_threadnet(cfg: ThreadNetConfig) -> ThreadNetResult:
 
     sim.run(main(), seed=cfg.seed)
     return result
+
+
+# ---------------------------------------------------------------------------
+# Chaos ThreadNet — the Praos network under a seeded FaultPlan
+# ---------------------------------------------------------------------------
+#
+# Reference shape: the io-sim fault exploration of the reference test suites
+# (attenuated bearers / AbsBearerInfo in ouroboros-network-framework's sim
+# tests) composed with ThreadNet's prop_general checks.  Nodes are wired
+# through diffusion.py's subscription layer (NOT the static mesh), so a
+# connection killed by a fault or watchdog is *suspended* by the error
+# policy (demotion) and *redialled* after backoff (re-promotion) — the
+# recovery loop this harness exists to exercise.
+
+from ..network.error_policy import (          # noqa: E402  (section import)
+    default_node_policies,
+)
+from ..node.diffusion import SimNetwork, run_sim_diffusion  # noqa: E402
+from ..node.watchdog import NodeTimeLimits    # noqa: E402
+from ..simharness import FaultPlan, FaultSpec, Partition    # noqa: E402
+
+
+def chaos_error_policies(scale: float = 1.0) -> list:
+    """The REAL policy set (default_node_policies) with durations scaled
+    to chaos-sim time — the production 200 s/60 s windows would outlast a
+    40-slot run."""
+    return default_node_policies(violation=8.0 * scale,
+                                 transport=4.0 * scale,
+                                 unknown=6.0 * scale)
+
+
+def chaos_time_limits() -> NodeTimeLimits:
+    """Watchdog limits scaled to the chaos net's 1 s slots (same ratios as
+    the production defaults in node/watchdog.py)."""
+    # must_reply stays ~7x the expected block interval (reference ratio:
+    # 135 s against ~20 s blocks) — tighter and a healthy-but-quiet
+    # producer gets spuriously killed during the settle window
+    return NodeTimeLimits(
+        chain_sync_short=3.0, chain_sync_must_reply=20.0,
+        keep_alive_timeout=3.0, block_fetch_busy=6.0,
+        fetch_deadline_floor=1.5, fetch_deadline_mult=4.0,
+        handshake_timeout=3.0)
+
+
+@dataclass
+class ChaosConfig:
+    """One chaos run: a ThreadNetConfig + the hostility applied to it.
+
+    The FaultSpec/Partition fields (not a FaultPlan instance) keep the
+    config pure data — run_chaos_threadnet builds a FRESH plan per run, so
+    replaying the same config replays the identical fault schedule."""
+    net: ThreadNetConfig = field(default_factory=ThreadNetConfig)
+    spec: FaultSpec = field(default_factory=FaultSpec)
+    partitions: tuple = ()               # Partition over node labels
+    base_backoff: float = 2.0
+    keepalive_interval: float = 2.0
+    settle_slots: int = 4
+    time_limits: NodeTimeLimits = field(default_factory=chaos_time_limits)
+    # slot after which per-message hostility stops (None = hostile through
+    # the settle window too).  Default: faults run for the measured
+    # n_slots, then the settle window is clean — the ThreadNet
+    # partition-heals-then-net-converges shape, so the final-chain
+    # common-prefix check judges recovery, not mid-fault luck.
+    fault_until_slot: Optional[int] = -1     # -1 -> net.n_slots
+    # multiplier on chaos_error_policies' suspension windows: the max
+    # escalated backoff must fit inside the settle window or a peer
+    # suspended in the hostile tail never rejoins before the snapshot
+    error_scale: float = 1.0
+
+
+@dataclass
+class ChaosResult(ThreadNetResult):
+    """ThreadNetResult + the observability a chaos run is judged on."""
+    seed: int = 0
+    fault_events: list = field(default_factory=list)   # plan.events
+    workers: list = field(default_factory=list)        # SubscriptionWorkers
+
+    # -- trace views ---------------------------------------------------------
+    def _events(self, label: str) -> list:
+        # trace_event(payload, label) records the label in SimEvent.kind
+        # (the `label` field is the emitting thread's, always "user" here)
+        return [e for e in self.trace if e.kind == label]
+
+    def watchdog_events(self) -> list:
+        """Every per-state timeout + the kills it caused."""
+        return self._events("watchdog")
+
+    def suspensions(self) -> list:
+        """(time, worker, addr, kind, duration, fail_count) demotions."""
+        return [(e.time, e.payload[0], *e.payload[2:])
+                for e in self._events("subscription")
+                if e.payload[1] == "suspend"]
+
+    def demoted_then_repromoted(self) -> list:
+        """Addresses that were suspended (demoted) and later redialled
+        (re-promoted) by the subscription layer — the recovery loop's
+        end-to-end evidence, readable from the trace alone."""
+        suspended_at: dict = {}
+        recovered = []
+        for e in self._events("subscription"):
+            worker, kind = e.payload[0], e.payload[1]
+            addr = e.payload[2]
+            key = (worker, addr)
+            if kind == "suspend":
+                suspended_at.setdefault(key, e.time)
+            elif kind == "dial" and key in suspended_at \
+                    and e.time > suspended_at[key] and addr not in recovered:
+                recovered.append(addr)
+        return recovered
+
+    def trace_tail(self, n: int = 40) -> str:
+        """The reproduction blurb chaos test failures print: seed + the
+        last n sim-trace events."""
+        tail = "\n".join(repr(e) for e in self.trace[-n:])
+        return (f"fault plan seed={self.seed} — rerun with this seed to "
+                f"reproduce; sim trace tail:\n{tail}")
+
+
+def run_chaos_threadnet(cfg: ChaosConfig) -> ChaosResult:
+    """Run the Praos network under cfg's FaultPlan, wired through the
+    subscription/diffusion layer so faulted peers are demoted (error-policy
+    suspension) and re-promoted (redial) instead of staying dead.
+
+    Deterministic end to end: the plan, the scheduler, the subscription
+    jitter and every watchdog all derive from cfg.net.seed, so two runs of
+    the same config produce byte-identical sim traces."""
+    factory = PraosNetworkFactory(cfg.net)
+    net = cfg.net
+    until_slot = net.n_slots if cfg.fault_until_slot == -1 \
+        else cfg.fault_until_slot
+    plan = FaultPlan(net.seed, cfg.spec, cfg.partitions,
+                     until=None if until_slot is None
+                     else until_slot * net.slot_length)
+    result = ChaosResult([], [], factory.keys, seed=net.seed)
+
+    def neighbors(i: int) -> list:
+        if net.topology == "mesh":
+            return [j for j in range(net.n_nodes) if j != i]
+        if net.topology == "ring":
+            return sorted({(i - 1) % net.n_nodes, (i + 1) % net.n_nodes}
+                          - {i})
+        if net.topology == "line":
+            return [j for j in (i - 1, i + 1) if 0 <= j < net.n_nodes]
+        raise ValueError(net.topology)
+
+    async def main():
+        network = SimNetwork(
+            link_delay=net.link_delay * net.slot_length,
+            fault_plan=plan)
+        kernels = [factory.make_node(i) for i in range(net.n_nodes)]
+        # every address must be listening before any worker dials, or the
+        # startup order would masquerade as connection failures
+        for i, kern in enumerate(kernels):
+            network.listen(f"addr{i}", kern)
+        worker_threads = []
+        for i, kern in enumerate(kernels):
+            kern.time_limits = cfg.time_limits
+            kern.keepalive_interval = cfg.keepalive_interval
+            kern.start()
+            d = run_sim_diffusion(
+                kern, network, f"addr{i}",
+                ip_targets=[f"addr{j}" for j in neighbors(i)],
+                valency=len(neighbors(i)),
+                error_policies=chaos_error_policies(cfg.error_scale),
+                base_backoff=cfg.base_backoff, seed=net.seed)
+            result.workers.extend(d.workers)
+            worker_threads.extend(d.threads)
+        await sim.sleep(net.n_slots * net.slot_length
+                        + cfg.settle_slots * net.slot_length)
+        for kern in kernels:
+            result.chains.append(kern.chain_db.current_chain.copy())
+            result.ledgers.append(kern.chain_db.current_ledger)
+        for t in worker_threads:
+            try:
+                t.poll()
+            except sim.AsyncCancelled:
+                pass
+            except BaseException as e:   # a THROW verdict or worker bug
+                result.failures.append(("subscription", t.label, e))
+        for kern in kernels:
+            kern.stop()
+
+    _, trace = sim.run_trace(main(), seed=net.seed)
+    result.trace = trace
+    result.fault_events = list(plan.events)
+    return result

@@ -1,0 +1,190 @@
+"""Chaos ThreadNet — the Praos network must survive seeded hostility.
+
+Tier-1 runs a small seed sweep (drops + stalls + disconnects + one
+scheduled partition on a 3-node mesh) and asserts the full recovery
+story per ISSUE 2's acceptance criteria:
+
+- common-prefix convergence on every seed (no sim deadlock — the sim
+  itself raises on one);
+- at least one peer demoted by a watchdog timeout / error-policy
+  suspension and later RE-promoted (redialled) by the subscription layer;
+- every fault and recovery decision visible as tracer events;
+- determinism: the same seed replayed produces a byte-identical sim
+  trace.
+
+A `slow`-marked wide sweep covers >= 20 seeds.  Failures print the fault
+plan seed and the sim trace tail (`ChaosResult.trace_tail`) so any chaos
+failure is reproducible from the report alone.
+
+Reference shape: io-sim attenuated-bearer experiments
+(ouroboros-network-framework sim tests) x Test/ThreadNet/General.hs
+prop_general, with the KeepAlive/Codec.hs 60 s reply limit scaled down.
+"""
+import pytest
+
+from ouroboros_tpu import simharness as sim
+from ouroboros_tpu.network.mux import (
+    CodecChannel, INITIATOR, Mux, RESPONDER, bearer_pair,
+)
+from ouroboros_tpu.network.protocols import keepalive
+from ouroboros_tpu.network.typed import CLIENT, SERVER, Session, run_peer
+from ouroboros_tpu.node.watchdog import KeepAliveTimeout
+from ouroboros_tpu.simharness import FaultPlan, FaultSpec, Partition
+from ouroboros_tpu.testing import (
+    ChaosConfig, ThreadNetConfig, run_chaos_threadnet,
+)
+
+TIER1_SEEDS = (1, 2, 3)
+WIDE_SEEDS = tuple(range(1, 21))
+
+
+def chaos_config(seed: int) -> ChaosConfig:
+    """Drops + stalls + disconnects + one partition on a 3-node mesh:
+    hostile for the 30 measured slots, then a clean settle window in
+    which the reconnect policy must heal the net."""
+    return ChaosConfig(
+        net=ThreadNetConfig(n_nodes=3, n_slots=30, k=10, f=0.5, seed=seed,
+                            topology="mesh"),
+        spec=FaultSpec(jitter=0.05, drop_prob=0.02, stall_prob=0.01,
+                       stall_for=4.0, disconnect_prob=0.01),
+        partitions=(
+            Partition(10.0, 16.0, (("node0",), ("node1", "node2"))),),
+        settle_slots=15,
+        # keep the worst escalated backoff inside the settle window, or a
+        # peer suspended late in the hostile tail misses the snapshot
+        error_scale=0.5,
+    )
+
+
+@pytest.mark.parametrize("seed", TIER1_SEEDS)
+def test_chaos_net_converges_and_recovers(seed):
+    r = run_chaos_threadnet(chaos_config(seed))
+    assert not r.failures, f"worker failures: {r.failures}\n{r.trace_tail()}"
+    assert r.common_prefix_ok(10), (
+        f"no common prefix, heights="
+    f"{[c.head_block_no for c in r.chains]}\n{r.trace_tail()}")
+    assert min(c.head_block_no for c in r.chains) >= 3, (
+        f"net made no progress under faults\n{r.trace_tail()}")
+    # fault injection actually happened, visible in the trace
+    assert r.fault_events, r.trace_tail()
+    assert any(e.kind == "fault" for e in r.trace), r.trace_tail()
+    # at least one watchdog tripped on a silent peer...
+    assert r.watchdog_events(), (
+        f"no watchdog fired under faults\n{r.trace_tail()}")
+    # ...at least one peer was demoted (error-policy suspension)...
+    assert r.suspensions(), f"no peer demoted\n{r.trace_tail()}"
+    # ...and demoted peers were later re-promoted (redialled)
+    assert r.demoted_then_repromoted(), (
+        f"no peer re-promoted after demotion\n{r.trace_tail()}")
+
+
+@pytest.mark.parametrize("seed", TIER1_SEEDS)
+def test_chaos_replay_is_byte_identical(seed):
+    """Fault injection must not break sim determinism: the whole point of
+    seeded chaos is that any failure reproduces from its seed."""
+    r1 = run_chaos_threadnet(chaos_config(seed))
+    r2 = run_chaos_threadnet(chaos_config(seed))
+    assert r1.fault_events == r2.fault_events
+    t1 = [repr(e) for e in r1.trace]
+    t2 = [repr(e) for e in r2.trace]
+    assert t1 == t2, f"replay diverged at event " \
+        f"{next(i for i, (a, b) in enumerate(zip(t1, t2)) if a != b)}"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", WIDE_SEEDS)
+def test_chaos_wide_sweep(seed):
+    r = run_chaos_threadnet(chaos_config(seed))
+    assert not r.failures, f"worker failures: {r.failures}\n{r.trace_tail()}"
+    assert r.common_prefix_ok(10), (
+        f"no common prefix, heights="
+        f"{[c.head_block_no for c in r.chains]}\n{r.trace_tail()}")
+    assert r.demoted_then_repromoted() or not r.suspensions(), (
+        f"demoted peers never re-promoted\n{r.trace_tail()}")
+
+
+# ---------------------------------------------------------------------------
+# KeepAlive under faults: a stalled responder trips the reply watchdog
+# ---------------------------------------------------------------------------
+
+def test_keepalive_timeout_kills_stalled_responder_cleanly():
+    """A responder whose replies never arrive (100% drop on its bearer)
+    must trip the keep-alive reply deadline (timeLimitsKeepAlive), the
+    kill must leave the mux closed with every channel poisoned, and the
+    sim must wind down with no leaked threads (every forked tid reaches a
+    terminal trace event)."""
+    plan = FaultPlan(seed=5, spec=FaultSpec(drop_prob=1.0))
+
+    async def main():
+        ba, bb = bearer_pair(sdu_size=1024)
+        # only the responder->initiator direction is hostile: probes
+        # arrive, replies vanish — the silent-stall shape
+        bb = plan.wrap_bearer(bb, "srv", "cli")
+        mux_a, mux_b = Mux(ba, "cli"), Mux(bb, "srv")
+        ka_a = CodecChannel(mux_a.channel(8, INITIATOR), keepalive.CODEC)
+        ka_b = CodecChannel(mux_b.channel(8, RESPONDER), keepalive.CODEC)
+        mux_a.start()
+        mux_b.start()
+
+        server = sim.spawn(run_peer(
+            keepalive.SPEC, SERVER, ka_b, keepalive.server),
+            label="ka-server")
+        sess = Session(keepalive.SPEC, CLIENT, ka_a)
+        client = sim.spawn(
+            keepalive.client_probe(sess, rounds=None, interval=0.5,
+                                   response_timeout=2.0),
+            label="ka-client")
+        try:
+            await client.wait()
+        except KeepAliveTimeout as e:
+            verdict = e
+        else:
+            raise AssertionError("stalled responder did not trip the "
+                                 "keep-alive watchdog")
+        # the kernel supervisor's contract: the kill tears the mux down
+        mux_a.stop()
+        mux_b.stop()
+        server.cancel()
+        await sim.yield_()
+        return verdict
+
+    verdict, trace = sim.run_trace(main(), seed=5)
+    assert verdict.protocol == "keep-alive"
+    assert verdict.state == "KAServer"
+    # the timeout decision is visible in the trace (debuggable chaos)
+    assert any(e.kind == "watchdog" for e in trace), \
+        "keep-alive timeout left no watchdog trace event"
+    assert any(e.kind == "fault" for e in trace), \
+        "dropped replies left no fault trace events"
+    # no leaked sim threads: every fork reached stop/cancelled/fail
+    forked = {e.tid for e in trace if e.kind == "fork"}
+    ended = {e.tid for e in trace
+             if e.kind in ("stop", "cancelled", "fail")}
+    leaked = forked - ended
+    assert not leaked, f"leaked sim threads: {leaked}"
+
+
+def test_keepalive_healthy_responder_untouched_by_watchdog():
+    """With no faults the reply deadline never fires: probes complete and
+    feed RTTs exactly as before the watchdog existed."""
+    async def main():
+        ba, bb = bearer_pair(sdu_size=1024, delay=0.01)
+        mux_a, mux_b = Mux(ba, "cli"), Mux(bb, "srv")
+        ka_a = CodecChannel(mux_a.channel(8, INITIATOR), keepalive.CODEC)
+        ka_b = CodecChannel(mux_b.channel(8, RESPONDER), keepalive.CODEC)
+        mux_a.start()
+        mux_b.start()
+        server = sim.spawn(run_peer(
+            keepalive.SPEC, SERVER, ka_b, keepalive.server),
+            label="ka-server")
+        sess = Session(keepalive.SPEC, CLIENT, ka_a)
+        rtts = await keepalive.client_probe(
+            sess, rounds=3, interval=0.5, response_timeout=2.0)
+        mux_a.stop()
+        mux_b.stop()
+        server.cancel()
+        return rtts
+
+    rtts = sim.run(main(), seed=1)
+    assert len(rtts) == 3
+    assert all(r >= 0.02 for r in rtts)      # two bearer hops per probe

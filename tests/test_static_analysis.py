@@ -342,6 +342,39 @@ def test_sim004_socket_call_fires_constants_allowed():
         "fx.py") == []
 
 
+def test_sim006_unbounded_receive_fires_in_node_scope_only():
+    src = (
+        "async def client(session):\n"
+        "    return await session.recv()\n")
+    f = sim_lint(src, "ouroboros_tpu/node/fx.py")
+    assert _rules(f) == {"SIM006"}
+    # same code outside node/ is out of scope (servers, tests, tools)
+    assert sim_lint(src, "ouroboros_tpu/network/fx.py") == []
+
+
+def test_sim006_collect_and_stm_queue_get_fire():
+    f = sim_lint(
+        "async def drain(session, q, sim):\n"
+        "    await session.collect()\n"
+        "    await sim.atomically(lambda tx: q.get(tx))\n"
+        "    await sim.atomically(q.get)\n",
+        "ouroboros_tpu/node/fx.py")
+    assert [x.rule for x in f] == ["SIM006"] * 3
+
+
+def test_sim006_bounded_receives_allowed():
+    # the watchdog helpers and sim.timeout wrappers are the sanctioned
+    # bounded forms; unrelated awaits must not fire either
+    assert sim_lint(
+        "from ouroboros_tpu.node.watchdog import recv_with_limit\n"
+        "async def client(session, limits, sim):\n"
+        "    msg = await recv_with_limit(session, limits)\n"
+        "    ok = await sim.timeout(5.0, noop())\n"
+        "    await sim.sleep(1.0)\n"
+        "    return msg, ok\n",
+        "ouroboros_tpu/node/fx.py") == []
+
+
 def test_sim005_blocking_open_fires_in_nested_helper_too():
     f = sim_lint(
         "async def load(path):\n"

@@ -1,0 +1,255 @@
+"""SubscriptionWorker reconnect policy + SuspendDecision semigroup laws.
+
+Regression surface for ISSUE 2's satellite bugfixes:
+
+- a CLEAN connection end must reset `fail_count` and must NOT escalate
+  the backoff exponent (the old code incremented fail_count on every
+  ending, so a cleanly churning peer walked itself to maximum backoff);
+- a THROW verdict from the error policies must surface as a fatal
+  `SubscriptionFatal` out of `run()`, not quietly become a backoff window;
+- suspend-peer marks the peer bad in both directions (`peer_until` /
+  `peer_suspended`), suspend-consumer only blocks our dialling.
+
+Reference: ouroboros-network-framework ErrorPolicy.hs (SuspendDecision
+semigroup), Subscription/Worker.hs + PeerState.hs (suspension clocks).
+"""
+import pytest
+
+from ouroboros_tpu import simharness as sim
+from ouroboros_tpu.network.error_policy import (
+    THROW, ErrorPolicy, SuspendDecision, default_node_policies,
+    suspend_consumer, suspend_peer,
+)
+from ouroboros_tpu.network.subscription import (
+    PeerState, SubscriptionFatal, SubscriptionWorker,
+)
+
+
+# ---------------------------------------------------------------------------
+# SuspendDecision semigroup laws (ErrorPolicy.hs:62-77)
+# ---------------------------------------------------------------------------
+
+_SAMPLES = [
+    THROW,
+    suspend_peer(0.0), suspend_peer(3.0), suspend_peer(7.0),
+    suspend_consumer(0.0), suspend_consumer(5.0), suspend_consumer(11.0),
+]
+
+
+class TestSuspendDecisionSemigroup:
+    def test_throw_dominates_both_sides(self):
+        for d in _SAMPLES:
+            assert (THROW | d).kind == "throw"
+            assert (d | THROW).kind == "throw"
+
+    def test_kind_ordering_peer_over_consumer(self):
+        assert (suspend_peer(1) | suspend_consumer(9)).kind == "suspend-peer"
+        assert (suspend_consumer(9) | suspend_peer(1)).kind == "suspend-peer"
+        assert (suspend_consumer(1) | suspend_consumer(2)).kind \
+            == "suspend-consumer"
+        assert (suspend_peer(1) | suspend_peer(2)).kind == "suspend-peer"
+
+    def test_duration_combines_by_max(self):
+        assert (suspend_peer(3) | suspend_consumer(9)).duration == 9
+        assert (suspend_consumer(9) | suspend_peer(3)).duration == 9
+        assert (suspend_peer(7) | suspend_peer(3)).duration == 7
+
+    def test_associative_and_commutative_on_samples(self):
+        for a in _SAMPLES:
+            for b in _SAMPLES:
+                assert a | b == b | a
+                for c in _SAMPLES:
+                    assert (a | b) | c == a | (b | c)
+
+    def test_idempotent(self):
+        for d in _SAMPLES:
+            combined = d | d
+            assert combined.kind == d.kind
+            if d.kind != "throw":
+                assert combined.duration == d.duration
+
+
+# ---------------------------------------------------------------------------
+# reconnect-policy unit tests (drive _on_conn_end directly inside the sim)
+# ---------------------------------------------------------------------------
+
+def _worker(**kw):
+    kw.setdefault("error_policies", default_node_policies())
+    kw.setdefault("base_backoff", 2.0)
+    return SubscriptionWorker(["a"], valency=1, dial=None, **kw)
+
+
+def _in_sim(fn, seed=0):
+    async def main():
+        return fn()
+    return sim.run(main(), seed=seed)
+
+
+def test_clean_end_resets_fail_count():
+    """REGRESSION: clean endings used to increment fail_count forever."""
+    def body():
+        w = _worker()
+        st = w.states["a"]
+        w._on_conn_end("a", ConnectionError("boom"))
+        w._on_conn_end("a", ConnectionError("boom"))
+        assert st.fail_count == 2
+        w._on_conn_end("a", None)            # clean session
+        assert st.fail_count == 0
+        return True
+
+    assert _in_sim(body)
+
+
+def test_clean_churn_never_escalates():
+    """REGRESSION: a peer that cleanly churns N times must keep paying the
+    base backoff (plus jitter), never the exponential ladder."""
+    def body():
+        w = _worker(jitter=0.25)
+        ceiling = w.base_backoff * 1.25 + 1e-9
+        for _ in range(10):
+            w._on_conn_end("a", None)
+            window = w.states["a"].suspended_until - sim.now()
+            assert w.base_backoff <= window <= ceiling, window
+        return True
+
+    assert _in_sim(body)
+
+
+def test_failure_backoff_is_exponential_and_capped():
+    def body():
+        w = _worker(jitter=0.0)
+        windows = []
+        for _ in range(8):
+            w._on_conn_end("a", ConnectionError("boom"))
+            windows.append(w.states["a"].consumer_until - sim.now())
+        # ConnectionError -> suspend_consumer(20.0); exponent is
+        # min(fail_count - 1, 5), so 20*1, 20*2, ... capped at 20*32
+        assert windows[0] == pytest.approx(20.0)
+        assert windows[1] == pytest.approx(40.0)
+        assert windows[5] == pytest.approx(20.0 * 32)
+        assert windows[7] == pytest.approx(20.0 * 32)   # capped
+        return True
+
+    assert _in_sim(body)
+
+
+def test_fail_count_reset_makes_next_backoff_small_again():
+    def body():
+        w = _worker(jitter=0.0)
+        for _ in range(4):
+            w._on_conn_end("a", ConnectionError("boom"))
+        w._on_conn_end("a", None)
+        w._on_conn_end("a", ConnectionError("boom"))
+        # back to the first rung of the ladder, not 2^4
+        window = w.states["a"].consumer_until - sim.now()
+        assert window == pytest.approx(20.0)
+        return True
+
+    assert _in_sim(body)
+
+
+def test_suspend_peer_sets_both_clocks_consumer_only_one():
+    class Violation(Exception):
+        pass
+
+    policies = [
+        ErrorPolicy(Violation, lambda e: suspend_peer(50.0)),
+        ErrorPolicy(ConnectionError, lambda e: suspend_consumer(20.0)),
+    ]
+
+    def body():
+        w = _worker(error_policies=policies, jitter=0.0)
+        st = w.states["a"]
+        w._on_conn_end("a", ConnectionError("transport"))
+        assert st.consumer_until > sim.now()
+        assert st.peer_until == 0.0
+        assert not w.peer_suspended("a")
+        w._on_conn_end("a", Violation("bad header"))
+        assert w.peer_suspended("a")
+        assert st.peer_until > sim.now()
+        # the dial-side clock is the max of both windows
+        assert st.suspended_until == max(st.consumer_until, st.peer_until)
+        return True
+
+    assert _in_sim(body)
+
+
+def test_backoff_jitter_is_seeded_and_deterministic():
+    def body():
+        w1 = SubscriptionWorker(["a"], 1, None, base_backoff=2.0, seed=7)
+        w2 = SubscriptionWorker(["a"], 1, None, base_backoff=2.0, seed=7)
+        w3 = SubscriptionWorker(["a"], 1, None, base_backoff=2.0, seed=8)
+        s1 = [w1._backoff(2.0, n) for n in range(6)]
+        s2 = [w2._backoff(2.0, n) for n in range(6)]
+        s3 = [w3._backoff(2.0, n) for n in range(6)]
+        assert s1 == s2
+        assert s1 != s3
+        return True
+
+    assert _in_sim(body)
+
+
+# ---------------------------------------------------------------------------
+# THROW propagation out of run() (satellite: eval_error_policies verdict
+# kind used to be ignored at this call site)
+# ---------------------------------------------------------------------------
+
+class _Poison(Exception):
+    pass
+
+
+def test_throw_verdict_is_fatal_not_backoff():
+    policies = [
+        ErrorPolicy(_Poison, lambda e: THROW),
+        ErrorPolicy(Exception, lambda e: suspend_consumer(5.0)),
+    ]
+
+    def dial(addr):
+        async def conn():
+            await sim.sleep(1.0)
+            raise _Poison("unrecoverable")
+        return sim.spawn(conn(), label=f"conn-{addr}")
+
+    w = SubscriptionWorker(["a"], valency=1, dial=dial,
+                           error_policies=policies, base_backoff=1.0)
+
+    async def main():
+        await w.run()
+
+    with pytest.raises(SubscriptionFatal) as ei:
+        sim.run(main(), seed=1)
+    assert isinstance(ei.value.__cause__, _Poison)
+
+
+def test_non_throw_verdict_still_backs_off_and_redials():
+    """The fatal path must not have broken ordinary suspension."""
+    dial_log = []
+
+    def dial(addr):
+        dial_log.append(sim.now())
+
+        async def conn():
+            await sim.sleep(0.5)
+            raise ConnectionError("flaky")
+        return sim.spawn(conn(), label=f"conn-{addr}")
+
+    w = SubscriptionWorker(["a"], valency=1, dial=dial,
+                           error_policies=default_node_policies(),
+                           base_backoff=1.0, jitter=0.0)
+
+    async def main():
+        h = sim.spawn(w.run(), label="worker")
+        await sim.sleep(200.0)
+        h.cancel()
+
+    sim.run(main(), seed=1)
+    assert len(dial_log) >= 3
+    # gaps grow: each redial waits the (exponentially larger) window
+    gaps = [b - a for a, b in zip(dial_log, dial_log[1:])]
+    assert gaps[1] > gaps[0]
+
+
+def test_peer_state_default_clocks():
+    st = PeerState()
+    assert st.suspended_until == 0.0
+    assert st.fail_count == 0

@@ -20,6 +20,17 @@ that would block the event loop or smuggle in wall-clock/OS entropy:
   snocket/bearer abstractions (socket module *constants* are fine).
 - SIM005 blocking-file-io: open()/io.open()/os.open() block the loop; go
   through storage.fs or the IO runtime's executor.
+
+One rule is scoped to ouroboros_tpu/node/ only (the peer-facing layer
+whose liveness the protocol watchdogs own):
+
+- SIM006 unbounded-receive: an `await` on a channel/queue receive
+  (`.recv()` / `.collect()` / `sim.atomically(...queue.get...)`) with no
+  time limit parks the thread forever when the peer goes silent — route
+  peer-facing receives through node/watchdog.py's recv_with_limit/
+  collect_with_limit (per-state ProtocolTimeLimits) or wrap the await in
+  sim.timeout.  Receives that legitimately wait forever (server loops on
+  client agency, internal work queues) are baselined with justifications.
 """
 from __future__ import annotations
 
@@ -34,9 +45,38 @@ IO_BOUNDARY = (
     "ouroboros_tpu/simharness/io_runtime.py",
     "ouroboros_tpu/network/socket_bearer.py",
 )
+# SIM006 applies only under this prefix (repo-relative, forward slashes)
+NODE_PREFIX = "ouroboros_tpu/node/"
 
 _RNG_FACTORIES = {"Random", "SystemRandom"}
 _OPEN_CALLS = {"open", "io.open", "os.open"}
+# receive method names whose bare await in node/ code is unbounded
+_RECV_ATTRS = {"recv", "collect"}
+
+
+def _is_unbounded_receive(call: ast.Call) -> bool:
+    """A channel/queue receive with no built-in bound: session.recv(),
+    session.collect(), or sim.atomically(<something reading a queue's
+    .get>).  The watchdog helpers (recv_with_limit/collect_with_limit)
+    and sim.timeout(...) wrappers do not match."""
+    name = dotted_name(call.func)
+    if name is None:
+        return False
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf in _RECV_ATTRS:
+        return True
+    if leaf == "atomically":
+        for arg in call.args:
+            # sim.atomically(q.get) or sim.atomically(lambda tx: q.get(tx))
+            if isinstance(arg, ast.Attribute) and arg.attr == "get":
+                return True
+            if isinstance(arg, ast.Lambda):
+                for sub in ast.walk(arg.body):
+                    if isinstance(sub, ast.Call):
+                        sub_name = dotted_name(sub.func) or ""
+                        if sub_name.rsplit(".", 1)[-1] == "get":
+                            return True
+    return False
 
 
 class _AsyncBodyLint(ast.NodeVisitor):
@@ -45,6 +85,7 @@ class _AsyncBodyLint(ast.NodeVisitor):
         self.findings: List[Finding] = []
         self._stack: List[str] = []
         self._async_depth = 0
+        self._node_scope = file.replace("\\", "/").startswith(NODE_PREFIX)
 
     @property
     def qualname(self) -> str:
@@ -102,6 +143,17 @@ class _AsyncBodyLint(ast.NodeVisitor):
                           f"{name}() is blocking file IO on the "
                           f"cooperative scheduler; use storage.fs or the "
                           f"IO runtime executor")
+        self.generic_visit(node)
+
+    def visit_Await(self, node: ast.Await):
+        if self._async_depth > 0 and self._node_scope \
+                and isinstance(node.value, ast.Call) \
+                and _is_unbounded_receive(node.value):
+            name = dotted_name(node.value.func)
+            self._add(node, "SIM006",
+                      f"unbounded await on {name}() — a silent peer parks "
+                      f"this thread forever; use node/watchdog.py's "
+                      f"recv_with_limit/collect_with_limit or sim.timeout")
         self.generic_visit(node)
 
 
