@@ -70,9 +70,14 @@ def median_spread(vals):
 def check_spread(name, vals):
     med, spread = median_spread(vals)
     if spread > SPREAD_WARN:
+        # min rides along (BENCH_r05 follow-up): on a noisy chip the min
+        # is the best estimate of the workload's true cost — if min is
+        # close to the median the spread is a slow-tail artifact, if the
+        # median is close to max the warm path itself is unstable
         log(f"WARNING: {name} spread {100 * spread:.0f}% over {len(vals)} "
             f"reps exceeds {100 * SPREAD_WARN:.0f}% — treat the median "
-            f"with suspicion (vals: {[round(v, 3) for v in vals]})")
+            f"with suspicion, prefer min {min(vals):.3f}s vs median "
+            f"{med:.3f}s (vals: {[round(v, 3) for v in vals]})")
     return med, spread
 
 
@@ -162,10 +167,22 @@ class TimingBackend:
         return attr
 
 
-def _timed_reps(fn, reps=None):
-    """Run fn() reps times, return the list of wall-times."""
+def _device_fence():
+    """Drain the async dispatch queue so a timed rep never inherits the
+    previous rep's in-flight device work (BENCH_r05: vrf primitive
+    spread 45% came from un-fenced back-to-back dispatches)."""
+    import jax
+    jax.block_until_ready(jax.device_put(0.0))
+
+
+def _timed_reps(fn, reps=None, warmup=1):
+    """Run fn() `warmup` un-timed times, then `reps` timed reps with a
+    block-until-ready fence before each; return the wall-times."""
+    for _ in range(warmup):
+        fn()
     vals = []
     for _ in range(reps or REPS):
+        _device_fence()
         t0 = time.perf_counter()
         fn()
         vals.append(time.perf_counter() - t0)
@@ -192,8 +209,10 @@ def bench_primitives(jb):
     def run_ed():
         assert all(jb.verify_ed25519_batch(reqs))
     run_ed()                                # warm/compile (+ autotune)
-    med, spread = check_spread("ed25519 primitive", _timed_reps(run_ed))
+    vals = _timed_reps(run_ed)              # + one fenced warmup rep
+    med, spread = check_spread("ed25519 primitive", vals)
     out["ed25519_batch_per_sec"] = round(n / med, 1)
+    out["ed25519_batch_per_sec_best"] = round(n / min(vals), 1)
     out["ed25519_spread"] = round(spread, 3)
     # VRF (config #2 primitive)
     nv = 2048
@@ -205,8 +224,10 @@ def bench_primitives(jb):
     def run_vrf():
         assert all(jb.verify_vrf_batch(vreqs))
     run_vrf()                               # warm/compile (+ autotune)
-    med, spread = check_spread("vrf primitive", _timed_reps(run_vrf))
+    vals = _timed_reps(run_vrf)             # + one fenced warmup rep
+    med, spread = check_spread("vrf primitive", vals)
     out["vrf_batch_per_sec"] = round(nv / med, 1)
+    out["vrf_batch_per_sec_best"] = round(nv / min(vals), 1)
     out["vrf_spread"] = round(spread, 3)
     # KES (config #3 primitive): hash path on host + leaf sigs on device
     nk = 4096
@@ -217,8 +238,10 @@ def bench_primitives(jb):
     def run_kes():
         assert all(jb.verify_kes_batch(kreqs))
     run_kes()                               # warm/compile
-    med, spread = check_spread("kes primitive", _timed_reps(run_kes))
+    vals = _timed_reps(run_kes)             # + one fenced warmup rep
+    med, spread = check_spread("kes primitive", vals)
     out["kes_batch_per_sec"] = round(nk / med, 1)
+    out["kes_batch_per_sec_best"] = round(nk / min(vals), 1)
     out["kes_spread"] = round(spread, 3)
     return out
 

@@ -87,7 +87,11 @@ class NodeTimeLimits:
         """The per-request BlockFetch watchdog: DeltaQ expected duration
         scaled by `fetch_deadline_mult` (slack for queueing + variance),
         floored and capped.  An unmeasured peer gets the full ceiling."""
-        if tracker is None or not getattr(tracker, "measured", True):
+        # default False: a tracker without the `measured` attribute is
+        # treated as UNmeasured (full ceiling) — failing the other way
+        # would hand an optimistic-default GSV the tight deadline and
+        # spuriously kill a healthy peer
+        if tracker is None or not getattr(tracker, "measured", False):
             return self.block_fetch_busy
         expected = tracker.expected_fetch_time(max(est_bytes, 1))
         return min(self.block_fetch_busy,

@@ -1,6 +1,11 @@
 """Device mesh construction for sharded batch validation."""
 from __future__ import annotations
 
+import os
+import sys
+import tempfile
+import time
+from contextlib import contextmanager
 from typing import Optional, Sequence
 
 import jax
@@ -8,6 +13,45 @@ from jax.sharding import Mesh
 
 
 WINDOW_AXIS = "window"   # the header-window (proof-batch) axis
+
+
+def enable_compile_cache(cache_dir: Optional[str] = None) -> str:
+    """Point XLA at a persistent compilation cache (MULTICHIP_r05
+    follow-up: the sharded ladder takes 4m+ to compile, which silently
+    ate the whole multichip timeout budget on a cold container).  Safe
+    to call repeatedly; returns the cache directory in effect.
+
+    Uses the same default directory as bench.py so single-chip bench
+    runs and mesh dryruns share compiled executables where shapes
+    agree."""
+    cache_dir = (cache_dir
+                 or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                 or os.path.join(tempfile.gettempdir(), "jax-ouro-cache"))
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # 0, not the default 1.0: the dryrun's tiny shapes compile in
+        # under a second and would otherwise recompile on EVERY
+        # container start without ever entering the persistent cache
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except (AttributeError, ValueError):
+        pass    # older jax: the env var alone still enables the cache
+    return cache_dir
+
+
+@contextmanager
+def log_compile_time(what: str, stream=None):
+    """Wall-time a compile-heavy block and print one log line, so a
+    multi-minute XLA compile shows up in the harness tail instead of
+    looking like a hang until the timeout kills it."""
+    stream = stream if stream is not None else sys.stderr
+    t0 = time.perf_counter()
+    print(f"[parallel] {what}: compiling...", file=stream, flush=True)
+    try:
+        yield
+    finally:
+        print(f"[parallel] {what}: done in "
+              f"{time.perf_counter() - t0:.1f}s", file=stream, flush=True)
 
 
 def make_mesh(n_devices: Optional[int] = None,
@@ -23,7 +67,6 @@ def make_mesh(n_devices: Optional[int] = None,
     # Honor JAX_PLATFORMS explicitly: some platform plugins (e.g. the axon
     # TPU tunnel) keep themselves as the default backend regardless, which
     # would silently ignore a requested virtual CPU mesh.
-    import os
     plat = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() or None
     devs = jax.devices(plat) if plat else jax.devices()
     if n_devices is not None:

@@ -32,6 +32,9 @@ from .faults import (
     FaultPlan, FaultSpec, FaultyBearer, FaultyChannel, LinkDown, Partition,
 )
 from .io_runtime import IoAsync, IoRuntime, io_run
+from .race import (
+    Race, RaceDetector, RaceReport, ScheduleController, explore_races,
+)
 from .stm import Retry, TBQueue, TMVar, TQueue, TVar, Tx, retry
 
 __all__ = [
@@ -39,6 +42,8 @@ __all__ = [
     "IoAsync", "IoRuntime", "io_run",
     "FaultPlan", "FaultSpec", "FaultyBearer", "FaultyChannel", "LinkDown",
     "Partition",
+    "Race", "RaceDetector", "RaceReport", "ScheduleController",
+    "explore_races",
     "atomically", "current_sim", "mask", "new_timeout", "now", "run",
     "run_trace", "sleep", "spawn", "timeout", "trace_event", "yield_",
     "Retry", "TBQueue", "TMVar", "TQueue", "TVar", "Tx", "retry",

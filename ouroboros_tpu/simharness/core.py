@@ -181,7 +181,8 @@ def current_sim() -> "Sim":
 
 class Sim:
     def __init__(self, seed: int = 0, collect_trace: bool = False,
-                 explore_schedules: bool = False):
+                 explore_schedules: bool = False,
+                 schedule_mode: Optional[str] = None, race=None):
         self.time = 0.0
         self._next_tid = 0
         self._timer_seq = 0
@@ -191,8 +192,20 @@ class Sim:
         self._trace: Trace = []
         self._collect = collect_trace
         self._rng = random.Random(seed)
-        self._explore = explore_schedules
+        # schedule perturbation (ouro-race exploration): "fifo" is the
+        # production schedule; "random"/"lifo" insert a preemption choice
+        # at every scheduler step.  explore_schedules is the legacy
+        # spelling of "random".
+        if schedule_mode is None:
+            schedule_mode = "random" if explore_schedules else "fifo"
+        if schedule_mode not in ("fifo", "random", "lifo"):
+            raise ValueError(f"unknown schedule_mode {schedule_mode!r}")
+        self._mode = schedule_mode
+        # happens-before race detector (simharness/race.py), or None.
+        # TVar hooks reach it through runtime.active_detector().
+        self._race = race
         self._main: Optional[_Thread] = None
+        self._current: Optional[_Thread] = None
         # tvar id -> [(thread, epoch), ...] blocked on an STM retry
         self._stm_waiters: dict[int, list[tuple[_Thread, int]]] = {}
 
@@ -214,6 +227,9 @@ class Sim:
         self._threads[tid] = t
         self._run_queue.append(t)
         self._ev(t, "fork")
+        if self._race is not None:
+            parent = self._current.tid if self._current is not None else None
+            self._race.on_fork(parent, t.tid, t.label)
         return t
 
     def spawn(self, coro: Coroutine, label: str = "") -> Async:
@@ -246,6 +262,18 @@ class Sim:
     # -- timers -------------------------------------------------------------
     def _add_timer(self, delay: float, fn: Callable[[], None]) -> int:
         self._timer_seq += 1
+        if self._race is not None:
+            # the callback runs with the clock its creator has NOW (the
+            # registration point) so HB flows through registerDelay-style
+            # wakeups; see race.py "timer" edge
+            token = self._race.on_timer_create()
+
+            def fn(inner=fn, token=token, race=self._race):
+                race.begin_timer(token)
+                try:
+                    inner()
+                finally:
+                    race.end_timer()
         heapq.heappush(self._timers, (self.time + max(delay, 0.0),
                                       self._timer_seq, fn))
         return self._timer_seq
@@ -294,12 +322,14 @@ class Sim:
                         "deadlock: no runnable threads, no timers; blocked: "
                         + ", ".join(f"{t.tid}:{t.label} on {t.blocked_on}"
                                     for t in blocked))
-                if self._explore and len(self._run_queue) > 1:
+                if self._mode == "random" and len(self._run_queue) > 1:
                     # O(n) pick is fine: exploration mode is for tests
                     i = self._rng.randrange(len(self._run_queue))
                     self._run_queue.rotate(-i)
                     thread = self._run_queue.popleft()
                     self._run_queue.rotate(i)
+                elif self._mode == "lifo" and len(self._run_queue) > 1:
+                    thread = self._run_queue.pop()
                 else:
                     thread = self._run_queue.popleft()
                 if thread.state != _RUNNABLE:
@@ -310,6 +340,11 @@ class Sim:
             # finally/__aexit__ blocks run and GC sees no un-awaited frames.
             # Runs BEFORE restoring _current_sim (cleanup may use sim APIs);
             # cleanup exceptions never replace the simulation's result.
+            # The race detector detaches first: teardown accesses happen
+            # outside any schedule with a stale thread ctx — recording
+            # them would misattribute them to the last-stepped thread
+            # and fabricate (or mask) races
+            self._race = None
             interrupt: Optional[BaseException] = None
             for t in self._threads.values():
                 if t.state not in (_DONE, _FAILED):
@@ -326,6 +361,9 @@ class Sim:
                 raise interrupt
 
     def _step(self, thread: _Thread):
+        self._current = thread
+        if self._race is not None:
+            self._race.set_ctx(thread.tid, thread.label)
         # a pending cancellation beats a pending STM re-run: the blocked
         # transaction aborts WITHOUT committing (GHC semantics — an async
         # exception delivered to a thread blocked in `atomically` rolls the
@@ -373,6 +411,9 @@ class Sim:
 
     def _finish(self, thread: _Thread):
         for w, ep in thread.waiters:
+            if self._race is not None and ep == w.block_epoch \
+                    and w.state == _BLOCKED:
+                self._race.on_join(w.tid, w.label, thread.tid, thread.label)
             if thread.state == _FAILED:
                 self._wake(w, exc=thread.exc, epoch=ep)
             else:
@@ -395,6 +436,9 @@ class Sim:
             self._run_queue.append(thread)
         elif kind == "wait":
             target: _Thread = eff.payload
+            if target.state in (_DONE, _FAILED) and self._race is not None:
+                self._race.on_join(thread.tid, thread.label,
+                                   target.tid, target.label)
             if target.state == _DONE:
                 thread.resume_value = target.result
                 self._run_queue.append(thread)
@@ -436,6 +480,10 @@ class Sim:
             thread.resume_exc = exc
             self._run_queue.append(thread)
         else:
+            if self._race is not None and (tx.read_vars or tx._writes):
+                self._race.on_commit(
+                    thread.tid, thread.label, dict(tx.read_vars),
+                    {vid: tvar for vid, (tvar, _v) in tx._writes.items()})
             written = tx.commit()
             if written:
                 self.stm_notify(written)
@@ -544,6 +592,8 @@ def new_timeout(seconds: float):
     tv = _stm.TVar(False, label=f"timeout@{sim.time + seconds:.6f}")
 
     def fire():
+        if sim._race is not None:   # timer write: HB edge, never a race
+            sim._race.on_raw_write(tv)
         tv._value = True
         sim.stm_notify([tv._id])
 

@@ -262,4 +262,10 @@ class FaultyChannel:
         return await self._inner.recv()
 
     async def wait_ready(self, timeout: float) -> bool:
+        # a fault-killed link reports ready IMMEDIATELY so the caller's
+        # recv raises LinkDown now — same contract as MuxChannel on a
+        # closed mux: transport death must not masquerade as peer
+        # silence and burn the whole watchdog limit
+        if self._plan._edge(self._src, self._dst).down:
+            return True
         return await self._inner.wait_ready(timeout)

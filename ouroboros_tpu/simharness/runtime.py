@@ -26,3 +26,13 @@ def current_or_none():
 def set_current(rt) -> None:
     global _current
     _current = rt
+
+
+def active_detector():
+    """The active runtime's happens-before race detector, or None.
+
+    Sim carries one only while an ouro-race exploration is attached
+    (simharness/race.py); the IO runtime never does.  TVar's peek and
+    set_notify hooks call this on every access, so it must stay a pair
+    of attribute reads — no isinstance, no raising."""
+    return getattr(_current, "_race", None)

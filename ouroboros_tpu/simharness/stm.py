@@ -19,6 +19,8 @@ from __future__ import annotations
 import itertools
 from typing import Any, Callable, Optional
 
+from . import runtime as _runtime
+
 __all__ = ["TVar", "Tx", "Retry", "retry", "TQueue", "TBQueue", "TMVar"]
 
 _tvar_ids = itertools.count()
@@ -48,15 +50,20 @@ class TVar:
 
     @property
     def value(self) -> Any:
+        det = _runtime.active_detector()
+        if det is not None:
+            det.on_peek(self)
         return self._value
 
     def set_notify(self, value: Any) -> None:
         """Runtime-internal: write outside a transaction and wake STM
         waiters.  For non-sim-thread producers (timer callbacks, registration
         hooks); user code should write through atomically()."""
-        from . import runtime
+        det = _runtime.active_detector()
+        if det is not None:
+            det.on_raw_write(self)
         self._value = value
-        runtime.current().stm_notify([self._id])
+        _runtime.current().stm_notify([self._id])
 
     def __repr__(self):
         return f"<TVar {self._id}{' ' + self.label if self.label else ''}={self._value!r}>"
@@ -65,15 +72,23 @@ class TVar:
 class Tx:
     """In-flight transaction: tracks read set and buffered writes."""
 
-    __slots__ = ("_sim", "read_set", "_writes")
+    __slots__ = ("_sim", "read_vars", "_writes")
 
     def __init__(self, sim):
         self._sim = sim
-        self.read_set: set[int] = set()
+        # id -> TVar: one store per read serves both the retry read-set
+        # (keys) and the race detector's commit hook, which needs the
+        # objects (their labels) — no extra cost on the STM hot path
+        self.read_vars: dict[int, TVar] = {}
         self._writes: dict[int, tuple[TVar, Any]] = {}
 
+    @property
+    def read_set(self):
+        """TVar ids read so far (retry registration uses this view)."""
+        return self.read_vars.keys()
+
     def read(self, tvar: TVar) -> Any:
-        self.read_set.add(tvar._id)
+        self.read_vars[tvar._id] = tvar
         if tvar._id in self._writes:
             return self._writes[tvar._id][1]
         return tvar._value

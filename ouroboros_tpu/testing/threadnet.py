@@ -315,6 +315,10 @@ def run_threadnet(cfg: ThreadNetConfig) -> ThreadNetResult:
         for s in starters:
             await s.wait()
 
+        # plan tasks are supervised (polled at snapshot time below): a
+        # fire-and-forget fork would swallow a failed submit/restart and
+        # the run would pass on a net that never saw its planned events
+        plan_tasks: list = []
         for slot, node_ix, tx_factory in cfg.tx_plan:
             async def submit(slot=slot, node_ix=node_ix,
                              tx_factory=tx_factory):
@@ -324,7 +328,7 @@ def run_threadnet(cfg: ThreadNetConfig) -> ThreadNetResult:
                 kern = started[node_ix]
                 tx = tx_factory(keys, kern.chain_db.current_ledger.ledger)
                 kern.mempool.try_add_txs([tx])
-            sim.spawn(submit(), label=f"tx@{slot}")
+            plan_tasks.append(sim.spawn(submit(), label=f"tx@{slot}"))
 
         for slot, node_ix in cfg.restart_plan:
             async def restart(slot=slot, node_ix=node_ix):
@@ -348,10 +352,23 @@ def run_threadnet(cfg: ThreadNetConfig) -> ThreadNetResult:
                         connect_nodes(started[a], started[b],
                                       delay=cfg.link_delay
                                       * cfg.slot_length)
-            sim.spawn(restart(), label=f"restart-{node_ix}@{slot}")
+            plan_tasks.append(sim.spawn(restart(),
+                                        label=f"restart-{node_ix}@{slot}"))
 
         await sim.sleep(cfg.n_slots * cfg.slot_length - sim.now()
                         + 2 * cfg.slot_length)
+        for t in plan_tasks:
+            try:
+                if not t.done:
+                    # poll() returns None for blocked AND for done-with-
+                    # None; a plan task still parked at snapshot time is
+                    # a planned event the net never saw — a failure
+                    result.failures.append(
+                        ("plan", t.label, "still blocked at snapshot"))
+                else:
+                    t.poll()
+            except BaseException as e:
+                result.failures.append(("plan", t.label, e))
         # settle: let in-flight messages drain with the clock stopped for
         # forging (no new slots matter; we just stop the world)
         for kern in started.values():
@@ -444,6 +461,7 @@ class ChaosResult(ThreadNetResult):
     seed: int = 0
     fault_events: list = field(default_factory=list)   # plan.events
     workers: list = field(default_factory=list)        # SubscriptionWorkers
+    race_report: Optional[object] = None   # RaceReport under explore=K
 
     # -- trace views ---------------------------------------------------------
     def _events(self, label: str) -> list:
@@ -486,14 +504,60 @@ class ChaosResult(ThreadNetResult):
                 f"reproduce; sim trace tail:\n{tail}")
 
 
-def run_chaos_threadnet(cfg: ChaosConfig) -> ChaosResult:
-    """Run the Praos network under cfg's FaultPlan, wired through the
-    subscription/diffusion layer so faulted peers are demoted (error-policy
-    suspension) and re-promoted (redial) instead of staying dead.
+# TVar labels whose races are tolerated during chaos exploration, with
+# the justification reviewable next to the suppression (the ouro-lint
+# baseline discipline applied to dynamic findings).  Patterns are
+# fnmatch globs over the TVar label.
+#
+# Everything here is an ORDER-INSENSITIVE access pattern: in the
+# cooperative runtime a sync block is atomic regardless of schedule, so
+# an unordered pair is only a bug when the two orders produce different
+# outcomes.  Monotone counters, one-way latches and re-validated peeks
+# commute; anything NOT matching these globs blocks the exploration
+# gate (tests/test_races.py).
+CHAOS_RACE_TOLERATED = {
+    "current-slot": "monotonic slot counter: readers peek the current "
+                    "slot and tolerate being one tick stale by design "
+                    "(the reference reads the slot clock non-atomically "
+                    "too); torn reads are impossible in the cooperative "
+                    "sim",
+    "*-fetch-wakeup": "edge-triggered poke counter: concurrent pokes "
+                      "coalesce and the fetch-logic loop re-reads the "
+                      "full decision state after every wake, so a lost "
+                      "increment only costs one extra (idempotent) "
+                      "decision pass",
+    "*-chain-version": "monotonic version counter poked from the ChainDB "
+                       "writer thread; followers re-validate against the "
+                       "real chain after waking, so stale peeks are "
+                       "self-healing",
+    "mempool-version": "same monotone version-counter shape as "
+                       "chain-version: watchers re-snapshot the mempool "
+                       "after every wake",
+    "chaindb-add-queue": "wake counter for the single add-block writer "
+                         "thread: the runner drains the whole queue "
+                         "after every wake and re-checks before "
+                         "blocking, so a coalesced increment is "
+                         "absorbed by the drain loop",
+    "fetch-req-*": "block_fetch._queued's documented non-transactional "
+                   "peek of the per-peer request queue: the decision "
+                   "loop re-runs on every fetch-wakeup poke, so a "
+                   "stale snapshot costs one extra decision pass, "
+                   "never a lost request",
+    "*.closed": "mux teardown latch: one-way False->True flips commute "
+                "(concurrent stop() calls are idempotent) and readers "
+                "racing the flip either see open and get woken by the "
+                "notify, or see closed",
+    "*.chanver": "mux ingress version counter: monotone, bumped per "
+                 "delivered SDU; channel readers re-check decodability "
+                 "under STM after every wake",
+}
 
-    Deterministic end to end: the plan, the scheduler, the subscription
-    jitter and every watchdog all derive from cfg.net.seed, so two runs of
-    the same config produce byte-identical sim traces."""
+
+def _chaos_setup(cfg: ChaosConfig):
+    """Fresh per-run state + the program coroutine factory.  Exploration
+    re-runs the SAME config under perturbed schedules, and every schedule
+    must get its own kernels/plan/result — sim programs are not
+    re-runnable."""
     factory = PraosNetworkFactory(cfg.net)
     net = cfg.net
     until_slot = net.n_slots if cfg.fault_until_slot == -1 \
@@ -550,7 +614,41 @@ def run_chaos_threadnet(cfg: ChaosConfig) -> ChaosResult:
         for kern in kernels:
             kern.stop()
 
-    _, trace = sim.run_trace(main(), seed=net.seed)
-    result.trace = trace
+    return plan, result, main
+
+
+def run_chaos_threadnet(cfg: ChaosConfig, explore: int = 0,
+                        tolerate=None) -> ChaosResult:
+    """Run the Praos network under cfg's FaultPlan, wired through the
+    subscription/diffusion layer so faulted peers are demoted (error-policy
+    suspension) and re-promoted (redial) instead of staying dead.
+
+    Deterministic end to end: the plan, the scheduler, the subscription
+    jitter and every watchdog all derive from cfg.net.seed, so two runs of
+    the same config produce byte-identical sim traces.
+
+    explore=K additionally attaches the happens-before race detector
+    (simharness/race.py) to the measured run — which IS exploration
+    schedule 0, the production FIFO schedule — and re-runs the same
+    config under K-1 further seeded schedule perturbations, returning
+    the RaceReport on ``result.race_report``.  `tolerate` overrides the
+    default CHAOS_RACE_TOLERATED label globs (each documented above)."""
+    plan, result, main = _chaos_setup(cfg)
+    det0 = sim.RaceDetector(schedule_index=0) if explore > 0 else None
+    measured = sim.Sim(seed=cfg.net.seed, collect_trace=True, race=det0)
+    measured.run(main())
+    result.trace = measured._trace
     result.fault_events = list(plan.events)
+    if explore > 0:
+        def make_program():
+            _plan, _result, fresh_main = _chaos_setup(cfg)
+            return fresh_main()
+        controller = sim.ScheduleController(
+            make_program, k=explore, seed=cfg.net.seed,
+            tolerate=tuple(CHAOS_RACE_TOLERATED
+                           if tolerate is None else tolerate))
+        # the measured FIFO run doubles as schedule 0: re-running it
+        # would be byte-identical wasted wall-clock
+        result.race_report = controller.explore(pre_collected=[det0],
+                                                start=1)
     return result

@@ -29,5 +29,11 @@ else:
     assert jax.devices()[0].platform == "cpu", (
         "tests must run on the virtual CPU mesh, not the real chip; got "
         f"{jax.devices()[0]}")
+    # persistent XLA compilation cache (shared with bench.py and the
+    # multichip dryrun): the sharded-verify kernels take minutes to
+    # compile cold, which would eat the tier-1 timeout budget on every
+    # container start instead of only the first
+    from ouroboros_tpu.parallel.mesh import enable_compile_cache
+    enable_compile_cache()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

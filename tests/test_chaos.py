@@ -164,6 +164,69 @@ def test_keepalive_timeout_kills_stalled_responder_cleanly():
     assert not leaked, f"leaked sim threads: {leaked}"
 
 
+def test_faulty_channel_wait_ready_reports_dead_link_immediately():
+    """A fault-killed edge must report ready at once (the caller's recv
+    then raises LinkDown) instead of parking the watchdog's wait_ready
+    for the full per-state limit — the same dead-transport contract
+    MuxChannel honors for a closed mux."""
+    from ouroboros_tpu.simharness import LinkDown
+    from ouroboros_tpu.simharness.faults import FaultyChannel
+
+    class NeverReady:
+        async def wait_ready(self, timeout):
+            await sim.sleep(timeout)
+            return False
+
+        async def recv(self):
+            raise AssertionError("recv must not reach a dead link's inner")
+
+    plan = FaultPlan(seed=1, spec=FaultSpec())
+    ch = FaultyChannel(NeverReady(), plan, "a", "b")
+    plan._edge("a", "b").down = True
+
+    async def main():
+        t0 = sim.now()
+        assert await ch.wait_ready(60.0) is True
+        assert sim.now() == t0          # immediate, no sim-time burned
+        try:
+            await ch.recv()
+        except LinkDown:
+            return "down"
+        raise AssertionError("recv on a dead link did not raise LinkDown")
+
+    assert sim.run(main(), seed=1) == "down"
+
+
+def test_plan_task_still_blocked_at_snapshot_is_a_failure():
+    """A planned event the net never saw must surface: a tx_plan task
+    parked past the end of the run is reported, not silently dropped."""
+    from ouroboros_tpu.testing import run_threadnet
+
+    cfg = ThreadNetConfig(
+        n_nodes=2, n_slots=4, k=5, f=1.0, seed=1, topology="line",
+        # slot far past the run's end: the submit task sleeps through
+        # the snapshot and must be flagged as still blocked
+        tx_plan=((400, 0, lambda keys, ledger: None),))
+    r = run_threadnet(cfg)
+    assert any(kind == "plan" and "still blocked" in str(detail)
+               for kind, _label, detail in r.failures), r.failures
+
+
+def test_fetch_deadline_unknown_tracker_gets_full_ceiling():
+    """A tracker without the `measured` attribute fails SAFE (treated as
+    unmeasured -> full busy ceiling), never the tight DeltaQ deadline."""
+    from ouroboros_tpu.node.watchdog import NodeTimeLimits
+
+    class BareTracker:                   # no `measured`, no GSV history
+        def expected_fetch_time(self, size):
+            return 0.001                 # optimistically tiny
+
+    limits = NodeTimeLimits()
+    assert limits.fetch_deadline(BareTracker(), 2048) \
+        == limits.block_fetch_busy
+    assert limits.fetch_deadline(None, 2048) == limits.block_fetch_busy
+
+
 def test_keepalive_healthy_responder_untouched_by_watchdog():
     """With no faults the reply deadline never fires: probes complete and
     feed RTTs exactly as before the watchdog existed."""

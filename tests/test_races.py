@@ -1,0 +1,305 @@
+"""ouro-race (simharness/race.py) — schedule-exploration race detector.
+
+Four test surfaces per ISSUE 4's acceptance criteria:
+(a) detector unit semantics: vector clocks, fork/join/commit HB edges,
+    atomic-pair exemption, tolerate globs;
+(b) the seeded-race fixtures: a known TVar race is found within K=16
+    schedules WITH a minimized two-thread interleaving repro, including
+    a branch-guarded race the default FIFO schedule never exercises;
+(c) determinism: same seed + same K => byte-identical reports;
+(d) the tier-1 exploration budget over the exact sims PR 2 made
+    concurrent but only ever tested under one schedule: the chaos
+    threadnet (kernel + subscription + watchdogs) and the
+    keepalive-stall watchdog sim — the live tree must be race-clean
+    modulo the justified CHAOS_RACE_TOLERATED globs.
+"""
+import pytest
+
+from ouroboros_tpu import simharness as sim
+from ouroboros_tpu.simharness import FaultSpec
+from ouroboros_tpu.simharness.race import ScheduleController, VClock
+from ouroboros_tpu.testing import ChaosConfig, ThreadNetConfig
+from ouroboros_tpu.testing.threadnet import (
+    CHAOS_RACE_TOLERATED, run_chaos_threadnet,
+)
+
+
+# --- (a) vector clocks ------------------------------------------------------
+
+def test_vclock_ordering():
+    a, b = VClock(), VClock()
+    a.tick(1)
+    assert a.leq(a)
+    assert not a.leq(b) and b.leq(a)        # empty <= everything
+    b.tick(2)
+    assert not a.leq(b) and not b.leq(a)    # concurrent
+    b.join(a)
+    assert a.leq(b) and not b.leq(a)
+
+
+# --- (b) seeded-race fixtures ----------------------------------------------
+
+def _racy_counter():
+    """The classic lost-update shape: peek, yield, raw write."""
+    async def main():
+        v = sim.TVar(0, label="counter")
+
+        async def bump():
+            x = v.value                     # non-transactional peek
+            await sim.yield_()
+            v.set_notify(x + 1)             # raw write: racy pair
+
+        a = sim.spawn(bump(), label="bump-a")
+        b = sim.spawn(bump(), label="bump-b")
+        await a.wait()
+        await b.wait()
+    return main()
+
+
+def test_seeded_tvar_race_found_within_k16_with_repro():
+    rep = sim.explore_races(_racy_counter, k=16, seed=0)
+    assert rep.found
+    assert not rep.failures
+    kinds = {(r.var, r.kind) for r in rep.races}
+    assert ("counter", "write-write") in kinds
+    assert ("counter", "read-write") in kinds
+    # the repro is a minimized TWO-thread interleaving naming both
+    # threads, the var, and the unordered pair
+    ww = next(r for r in rep.races if r.kind == "write-write")
+    assert {ww.a_thread, ww.b_thread} == {"bump-a", "bump-b"}
+    assert ww.trace and ww.trace[-1].startswith("=> unordered:")
+    assert any("counter" in line for line in ww.trace)
+    assert len(ww.trace) <= 24
+
+
+def test_branch_guarded_race_needs_exploration():
+    """A race behind a schedule-dependent branch: the default FIFO
+    schedule never runs the racing write, K=16 perturbed schedules do —
+    the exploreRaces/IOSimPOR motivation in one fixture."""
+    def make():
+        async def main():
+            flag = sim.TVar(False, label="flag")
+            data = sim.TVar(0, label="data")
+
+            async def t1():
+                await sim.atomically(lambda tx: tx.write(data, 1))
+                flag.set_notify(True)
+
+            async def t2():
+                if flag.value:              # schedule-dependent branch
+                    data.set_notify(2)      # races with t1's tx write
+
+            a = sim.spawn(t1(), label="writer")
+            b = sim.spawn(t2(), label="racer")
+            await a.wait()
+            await b.wait()
+        return main()
+
+    fifo_only = ScheduleController(make, k=1, seed=0).explore()
+    assert not any(r.var == "data" for r in fifo_only.races), \
+        "schedule 0 must not exercise the guarded branch"
+    explored = ScheduleController(make, k=16, seed=0).explore()
+    data_races = [r for r in explored.races if r.var == "data"]
+    assert data_races, explored.render()
+    assert data_races[0].kind == "write-write"
+    assert data_races[0].schedule > 0       # found by a PERTURBED schedule
+
+
+def test_atomic_only_program_is_race_free():
+    def make():
+        async def main():
+            v = sim.TVar(0, label="counter")
+
+            async def bump():
+                await sim.atomically(
+                    lambda tx: tx.modify(v, lambda x: x + 1))
+
+            a = sim.spawn(bump(), label="bump-a")
+            b = sim.spawn(bump(), label="bump-b")
+            await a.wait()
+            await b.wait()
+            assert v.value == 2
+        return main()
+    rep = sim.explore_races(make, k=8, seed=0)
+    assert not rep.found and not rep.failures, rep.render()
+
+
+def test_fork_join_edges_order_accesses():
+    """Raw accesses ordered by fork (parent-before-child) and join
+    (child-before-wait()er) must NOT report: the HB model understands
+    thread structure, not just schedules."""
+    def make():
+        async def main():
+            v = sim.TVar(0, label="handoff")
+            v.set_notify(1)                 # parent, pre-fork
+
+            async def child():
+                v.set_notify(v.value + 1)   # ordered after fork
+
+            c = sim.spawn(child(), label="child")
+            await c.wait()
+            v.set_notify(v.value + 1)       # ordered after join
+            assert v.value == 3
+        return main()
+    rep = sim.explore_races(make, k=8, seed=3)
+    assert not rep.found and not rep.failures, rep.render()
+
+
+def test_timer_writes_are_hb_edges_not_races():
+    """new_timeout's flip races with nobody: timers are scheduler-
+    mediated sync (the whole point of registerDelay), and the woken
+    reader is ordered after the creator through the released clock."""
+    def make():
+        async def main():
+            tv = sim.new_timeout(1.0)
+
+            async def watcher():
+                def tx_fn(tx):
+                    tx.check(tx.read(tv))
+                    return True
+                return await sim.atomically(tx_fn)
+
+            w = sim.spawn(watcher(), label="watcher")
+            assert await w.wait() is True
+        return main()
+    rep = sim.explore_races(make, k=8, seed=0)
+    assert not rep.found and not rep.failures, rep.render()
+
+
+def test_tolerate_globs_split_not_suppress():
+    rep = sim.explore_races(_racy_counter, k=4, seed=0,
+                            tolerate=("count*",))
+    assert not rep.races
+    assert rep.tolerated            # visible, non-blocking
+    assert "tolerated:" in rep.render()
+
+
+def test_polling_own_timeout_flag_is_not_a_race():
+    """The natural registerDelay idiom — poll the flag your own timer
+    flips — must never report: the timer exemption is two-sided."""
+    def make():
+        async def main():
+            tv = sim.new_timeout(1.0)
+            while not tv.value:
+                await sim.sleep(0.5)
+        return main()
+    rep = sim.explore_races(make, k=4, seed=0)
+    assert not rep.found and not rep.failures, rep.render()
+
+
+def test_exploration_records_base_exception_failures():
+    """AsyncCancelled is a BaseException — the most timing-dependent
+    failure shape a perturbed schedule provokes.  It must land in
+    report.failures, not abort the exploration and lose every schedule
+    already collected."""
+    def make():
+        async def main():
+            raise sim.AsyncCancelled()
+        return main()
+    rep = sim.explore_races(make, k=3, seed=0)
+    assert rep.schedules_run == 3
+    assert len(rep.failures) == 3
+    assert all("AsyncCancelled" in msg for _i, msg in rep.failures)
+
+
+# --- (c) determinism --------------------------------------------------------
+
+def test_same_seed_same_k_byte_identical_report():
+    r1 = sim.explore_races(_racy_counter, k=16, seed=7).render()
+    r2 = sim.explore_races(_racy_counter, k=16, seed=7).render()
+    assert r1 == r2
+    # and a different seed may differ in schedules but must still find
+    # the always-present race
+    r3 = sim.explore_races(_racy_counter, k=16, seed=8)
+    assert r3.found
+
+
+# --- (d) tier-1 exploration budget over the PR-2 sims -----------------------
+
+def _chaos_cfg(seed: int) -> ChaosConfig:
+    """Small: the exploration re-runs the whole net per schedule."""
+    return ChaosConfig(
+        net=ThreadNetConfig(n_nodes=3, n_slots=8, k=10, f=0.5, seed=seed,
+                            topology="mesh"),
+        spec=FaultSpec(jitter=0.05, drop_prob=0.02, stall_prob=0.01,
+                       stall_for=2.0, disconnect_prob=0.01),
+        settle_slots=4, error_scale=0.5,
+    )
+
+
+def test_chaos_threadnet_exploration_race_clean():
+    """The kernel/subscription/watchdog stack under K=3 perturbed
+    schedules: no races outside the justified CHAOS_RACE_TOLERATED
+    globs, no schedule-dependent crashes."""
+    r = run_chaos_threadnet(_chaos_cfg(seed=2), explore=3)
+    rep = r.race_report
+    assert rep is not None and rep.schedules_run == 3
+    assert rep.failures == [], rep.render()
+    assert rep.races == [], "untolerated races on the live tree:\n" \
+        + rep.render()
+    # the detector is actually observing the net, not vacuously clean
+    assert rep.tolerated, "exploration saw no accesses at all?"
+
+
+def test_chaos_explore_zero_is_default_and_reportless():
+    r = run_chaos_threadnet(_chaos_cfg(seed=3))
+    assert r.race_report is None
+
+
+@pytest.mark.slow
+def test_chaos_exploration_report_deterministic():
+    a = run_chaos_threadnet(_chaos_cfg(seed=2), explore=2)
+    b = run_chaos_threadnet(_chaos_cfg(seed=2), explore=2)
+    assert a.race_report.render() == b.race_report.render()
+
+
+def test_keepalive_watchdog_sim_exploration_race_clean():
+    """The keepalive-stall kill path (PR 2's watchdog sim) under
+    perturbed schedules: the timeout still fires on every schedule and
+    the mux teardown exposes no untolerated races."""
+    from ouroboros_tpu.network.mux import (
+        CodecChannel, INITIATOR, Mux, RESPONDER, bearer_pair,
+    )
+    from ouroboros_tpu.network.protocols import keepalive
+    from ouroboros_tpu.network.typed import CLIENT, SERVER, Session, run_peer
+    from ouroboros_tpu.node.watchdog import KeepAliveTimeout
+    from ouroboros_tpu.simharness import FaultPlan
+
+    def make():
+        plan = FaultPlan(seed=5, spec=FaultSpec(drop_prob=1.0))
+
+        async def main():
+            ba, bb = bearer_pair(sdu_size=1024)
+            bb = plan.wrap_bearer(bb, "srv", "cli")
+            mux_a, mux_b = Mux(ba, "cli"), Mux(bb, "srv")
+            ka_a = CodecChannel(mux_a.channel(8, INITIATOR),
+                                keepalive.CODEC)
+            ka_b = CodecChannel(mux_b.channel(8, RESPONDER),
+                                keepalive.CODEC)
+            mux_a.start()
+            mux_b.start()
+            server = sim.spawn(run_peer(
+                keepalive.SPEC, SERVER, ka_b, keepalive.server),
+                label="ka-server")
+            sess = Session(keepalive.SPEC, CLIENT, ka_a)
+            client = sim.spawn(
+                keepalive.client_probe(sess, rounds=None, interval=0.5,
+                                       response_timeout=2.0),
+                label="ka-client")
+            try:
+                await client.wait()
+            except KeepAliveTimeout:
+                pass
+            else:
+                raise AssertionError("stalled responder did not trip "
+                                     "the keep-alive watchdog")
+            mux_a.stop()
+            mux_b.stop()
+            server.cancel()
+            await sim.yield_()
+        return main()
+
+    rep = sim.explore_races(make, k=4, seed=5,
+                            tolerate=tuple(CHAOS_RACE_TOLERATED))
+    assert rep.failures == [], rep.render()
+    assert rep.races == [], rep.render()

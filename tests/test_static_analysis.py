@@ -1,9 +1,9 @@
 """ouro-lint (tools/analysis) — live-tree gates + seeded-violation fixtures.
 
 Two test surfaces:
-(a) the three passes run over the live tree as tier-1 assertions: the
-    protocol pass must be clean with NO baseline help, the jax/sim passes
-    clean modulo the committed baseline;
+(a) the four passes run over the live tree as tier-1 assertions: the
+    protocol pass must be clean with NO baseline help, the jax/sim/conc
+    passes clean modulo the committed baseline;
 (b) fixture snippets with seeded violations prove every rule actually
     fires (no false-negative lint) and that the allowlisted idioms don't
     (no cheap false positives).
@@ -16,6 +16,7 @@ import sys
 import pytest
 
 from tools.analysis import Baseline, Finding, run_passes
+from tools.analysis.conc_pass import lint_source as conc_lint
 from tools.analysis.jax_pass import lint_source as jax_lint
 from tools.analysis.protocol_pass import (
     check_spec, discover, message_inventory,
@@ -50,6 +51,18 @@ def test_jax_and_sim_passes_clean_modulo_baseline():
     report = run_passes(["jax", "sim"], Baseline.load())
     assert report.new == [], "\n".join(f.render() for f in report.new)
     assert report.stale == [], report.stale
+
+
+def test_conc_pass_live_tree_clean_modulo_baseline():
+    """Acceptance (ISSUE 4): the CONC pass gates the live tree with an
+    empty-or-justified baseline — every suppression names why the
+    unordered access commutes."""
+    report = run_passes(["conc"], Baseline.load())
+    assert report.new == [], "\n".join(f.render() for f in report.new)
+    assert report.stale == [], report.stale
+    for e in Baseline.load().entries.get("conc", []):
+        assert e["justification"].strip() and "TODO" not in \
+            e["justification"], e
 
 
 def test_baseline_entries_all_carry_justifications():
@@ -384,6 +397,166 @@ def test_sim005_blocking_open_fires_in_nested_helper_too():
         "    return slurp()\n", "fx.py")
     assert _rules(f) == {"SIM005"}
     assert f[0].symbol == "load.slurp"
+
+
+# --- (b) conc-pass fixtures --------------------------------------------------
+
+def test_conc001_set_notify_and_value_write_fire():
+    f = conc_lint(
+        "async def poke(tv):\n"
+        "    tv.set_notify(1)\n"
+        "    tv._value = 2\n", "fx.py")
+    assert [x.rule for x in f] == ["CONC001", "CONC001"]
+
+
+def test_conc001_own_private_attr_allowed():
+    # `self._value = ...` defines one's OWN attribute (the standard
+    # Python idiom) — TVars are never `self` outside the runtime impl
+    assert conc_lint(
+        "class Box:\n"
+        "    def __init__(self, v):\n"
+        "        self._value = v\n", "fx.py") == []
+
+
+def test_conc002_blocking_in_atomic_fires():
+    f = conc_lint(
+        "import time\n"
+        "async def go(sim, q):\n"
+        "    await sim.atomically(lambda tx: time.sleep(1))\n", "fx.py")
+    assert _rules(f) == {"CONC002"}
+    # a named local tx fn is resolved and linted too, await included
+    f2 = conc_lint(
+        "async def go(sim, session):\n"
+        "    async def tx_fn(tx):\n"
+        "        return await session.recv()\n"
+        "    await sim.atomically(tx_fn)\n", "fx.py")
+    assert "CONC002" in _rules(f2)
+
+
+def test_conc002_retry_and_check_allowed():
+    assert conc_lint(
+        "async def go(sim, q, v):\n"
+        "    def tx_fn(tx):\n"
+        "        tx.check(tx.read(v) > 0)\n"
+        "        return q.get(tx)\n"
+        "    return await sim.atomically(tx_fn)\n", "fx.py") == []
+
+
+def test_conc003_global_mutation_in_async_fires_sync_allowed():
+    f = conc_lint(
+        "COUNT = 0\n"
+        "async def bump():\n"
+        "    global COUNT\n"
+        "    COUNT += 1\n", "fx.py")
+    assert _rules(f) == {"CONC003"}
+    assert conc_lint(
+        "COUNT = 0\n"
+        "def host_side():\n"
+        "    global COUNT\n"
+        "    COUNT += 1\n", "fx.py") == []
+
+
+def test_conc003_nested_local_shadow_not_flagged():
+    # a nested helper's local binding of the same name is a FRESH scope,
+    # not the declared global — must not fire
+    assert conc_lint(
+        "COUNT = 0\n"
+        "async def f():\n"
+        "    global COUNT\n"
+        "    def helper():\n"
+        "        COUNT = 5\n"
+        "        return COUNT\n"
+        "    return helper()\n", "fx.py") == []
+
+
+def test_conc004_bare_spawn_fires_supervised_allowed():
+    f = conc_lint(
+        "async def go(sim, work):\n"
+        "    sim.spawn(work())\n", "fx.py")
+    assert _rules(f) == {"CONC004"}
+    assert conc_lint(
+        "async def go(sim, work, threads):\n"
+        "    t = sim.spawn(work())\n"
+        "    threads.append(sim.spawn(work()))\n"
+        "    await t.wait()\n", "fx.py") == []
+
+
+def test_conc005_nested_atomically_fires_or_else_allowed():
+    f = conc_lint(
+        "async def go(sim, v):\n"
+        "    def tx_fn(tx):\n"
+        "        return sim.atomically(lambda t2: t2.read(v))\n"
+        "    await sim.atomically(tx_fn)\n", "fx.py")
+    assert "CONC005" in _rules(f)
+    assert conc_lint(
+        "async def go(sim, v, w):\n"
+        "    def tx_fn(tx):\n"
+        "        return tx.or_else(lambda t: t.read(v),\n"
+        "                          lambda t: t.read(w))\n"
+        "    await sim.atomically(tx_fn)\n", "fx.py") == []
+
+
+# --- baseline canonical form -------------------------------------------------
+
+def test_baseline_load_dump_round_trips_byte_identically(tmp_path):
+    """--write-baseline on an unchanged tree must be a zero-line diff:
+    dump emits the canonical (file, rule, symbol, justification) key
+    order the committed file uses."""
+    committed = os.path.join(REPO, "tools", "analysis", "baseline.json")
+    out = tmp_path / "bl.json"
+    Baseline.load().dump(str(out))
+    assert out.read_bytes() == open(committed, "rb").read()
+
+
+def test_write_baseline_on_unchanged_tree_is_noop(tmp_path):
+    committed = os.path.join(REPO, "tools", "analysis", "baseline.json")
+    bl = tmp_path / "bl.json"
+    import shutil
+    shutil.copy(committed, bl)
+    r = _cli("--write-baseline", "--baseline", str(bl))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert bl.read_bytes() == open(committed, "rb").read()
+
+
+# --- machine-readable output (--format json/sarif) ---------------------------
+
+def test_cli_format_json_schema_and_exit_code():
+    r = _cli("--format", "json", "--strict")
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["tool"] == "ouro-lint" and doc["schema_version"] == 1
+    assert doc["blocking"] is False and doc["new"] == []
+    assert set(doc["summary"]) == {"conc", "jax", "protocol", "sim"}
+    assert doc["baselined"], "committed baseline findings must surface"
+    for f in doc["baselined"]:
+        assert set(f) == {"file", "line", "rule", "symbol", "message"}
+
+
+def test_cli_format_json_blocking_on_no_baseline():
+    r = _cli("--format", "json", "--no-baseline")
+    assert r.returncode == 1
+    doc = json.loads(r.stdout)
+    assert doc["blocking"] is True and doc["new"]
+
+
+def test_cli_format_sarif_minimal_valid():
+    r = _cli("--format", "sarif")
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "ouro-lint"
+    rules = {x["id"] for x in run["tool"]["driver"]["rules"]}
+    results = run["results"]
+    assert results, "baselined findings must appear as notes"
+    for res in results:
+        assert res["ruleId"] in rules
+        assert res["level"] in ("error", "note")
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith(".py")
+        assert loc["region"]["startLine"] >= 1
+        if res["level"] == "note":
+            assert res["suppressions"]
 
 
 # --- CLI exit-code semantics ------------------------------------------------

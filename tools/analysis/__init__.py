@@ -70,7 +70,9 @@ def register(name: str):
 
 
 def _ensure_passes_loaded() -> None:
-    from . import jax_pass, protocol_pass, sim_pass  # noqa: F401
+    from . import (  # noqa: F401
+        conc_pass, jax_pass, protocol_pass, sim_pass,
+    )
 
 
 # --- baseline ---------------------------------------------------------------
@@ -120,15 +122,39 @@ class Baseline:
         entries = dict(existing.entries)
         for name, fs in sorted(by_pass.items()):
             kept = existing.keys_for(name)
-            entries[name] = [
-                {"file": f.file, "rule": f.rule, "symbol": f.symbol,
-                 "justification": kept.get(f.key) or "TODO: justify or fix"}
-                for f in sorted(set(fs))]
+            # dedup by the baseline's own identity (file, rule, symbol):
+            # two findings in one symbol (e.g. a set_notify + its _value
+            # fallback) must yield ONE entry, or edits leave a
+            # contradictory twin the matcher can never distinguish
+            seen: set = set()
+            entries[name] = []
+            for f in sorted(set(fs)):
+                if f.key in seen:
+                    continue
+                seen.add(f.key)
+                entries[name].append(
+                    {"file": f.file, "rule": f.rule, "symbol": f.symbol,
+                     "justification": kept.get(f.key)
+                     or "TODO: justify or fix"})
         return cls(entries=entries)
 
     def dump(self, path: str = BASELINE_PATH) -> None:
+        """Canonical form: sections alphabetical, entries sorted by
+        (file, rule, symbol), entry keys in (file, rule, symbol,
+        justification) order.  load->dump round-trips byte-identically,
+        so a --write-baseline on an unchanged tree produces a zero-line
+        diff (tests/test_static_analysis.py gates this)."""
+        data = {
+            name: [{"file": e["file"], "rule": e["rule"],
+                    "symbol": e["symbol"],
+                    "justification": e.get("justification", "")}
+                   for e in sorted(self.entries[name],
+                                   key=lambda e: (e["file"], e["rule"],
+                                                  e["symbol"]))]
+            for name in sorted(self.entries)
+        }
         with open(path, "w") as f:
-            json.dump(self.entries, f, indent=2, sort_keys=True)
+            json.dump(data, f, indent=2)
             f.write("\n")
 
 

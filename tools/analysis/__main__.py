@@ -1,14 +1,17 @@
 """ouro-lint CLI.
 
-    python -m tools.analysis [--strict] [--passes protocol,jax,sim]
+    python -m tools.analysis [--strict] [--passes protocol,jax,sim,conc]
                              [--baseline PATH | --no-baseline]
                              [--write-baseline]
+                             [--format text|json|sarif]
 
 Exit codes: 0 clean, 1 non-baselined findings (under --strict also stale
-baseline entries), 2 internal error.  Baselined findings are printed but
-never block.  Runs fully on CPU: the passes are AST walks plus one import
-of the (jax-free) protocols package, so JAX_PLATFORMS=cpu is forced
-before anything else can pull jax in.
+baseline entries), 2 internal error — identical across output formats,
+so CI keys off the exit code and feeds the JSON/SARIF to annotations.
+Baselined findings are printed but never block.  Runs fully on CPU: the
+passes are AST walks plus one import of the (jax-free) protocols
+package, so JAX_PLATFORMS=cpu is forced before anything else can pull
+jax in.
 """
 import os
 import sys
@@ -35,7 +38,11 @@ def main(argv=None) -> int:
     ap.add_argument("--strict", action="store_true",
                     help="also fail (exit 1) on stale baseline entries")
     ap.add_argument("--passes", default=None,
-                    help="comma-separated subset of: protocol,jax,sim")
+                    help="comma-separated subset of: protocol,jax,sim,conc")
+    ap.add_argument("--format", default="text",
+                    choices=("text", "json", "sarif"),
+                    help="output format (default text; json/sarif print "
+                         "one document on stdout for CI/editors)")
     ap.add_argument("--baseline", default=BASELINE_PATH,
                     help=f"baseline file (default {BASELINE_PATH})")
     ap.add_argument("--no-baseline", action="store_true",
@@ -53,24 +60,33 @@ def main(argv=None) -> int:
     report = run_passes(names, Baseline() if args.no_baseline else on_disk)
 
     if args.write_baseline:
-        Baseline.from_findings(report.by_pass, existing=on_disk).dump(
-            args.baseline)
-        print(f"wrote {sum(len(v) for v in report.by_pass.values())} "
+        regenerated = Baseline.from_findings(report.by_pass,
+                                             existing=on_disk)
+        regenerated.dump(args.baseline)
+        print(f"wrote {sum(len(v) for v in regenerated.entries.values())} "
               f"entries to {args.baseline}")
         return 0
 
-    for f in report.baselined:
-        print(f"baselined: {f.render()}")
-    for f in report.new:
-        print(f.render())
-    for pass_name, key in report.stale:
-        print(f"stale baseline entry [{pass_name}]: {key[0]} {key[1]} "
-              f"[{key[2]}] — finding no longer exists; remove it")
+    if args.format != "text":
+        import json as _json
 
-    checked = ", ".join(f"{name}: {len(fs)} finding(s)"
-                        for name, fs in sorted(report.by_pass.items()))
-    print(f"ouro-lint: {checked}; {len(report.new)} blocking, "
-          f"{len(report.baselined)} baselined, {len(report.stale)} stale")
+        from tools.analysis.render import report_to_json, report_to_sarif
+        doc = report_to_sarif(report) if args.format == "sarif" \
+            else report_to_json(report, strict=args.strict)
+        print(_json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        for f in report.baselined:
+            print(f"baselined: {f.render()}")
+        for f in report.new:
+            print(f.render())
+        for pass_name, key in report.stale:
+            print(f"stale baseline entry [{pass_name}]: {key[0]} {key[1]} "
+                  f"[{key[2]}] — finding no longer exists; remove it")
+
+        checked = ", ".join(f"{name}: {len(fs)} finding(s)"
+                            for name, fs in sorted(report.by_pass.items()))
+        print(f"ouro-lint: {checked}; {len(report.new)} blocking, "
+              f"{len(report.baselined)} baselined, {len(report.stale)} stale")
 
     if report.new:
         return 1

@@ -8,10 +8,14 @@ from typing import Iterable, Iterator, List, Optional, Tuple
 from . import REPO_ROOT
 
 
-def iter_py_files(*subdirs: str, exclude: Iterable[str] = ()) -> Iterator[str]:
+def iter_py_files(*subdirs: str, exclude: Iterable[str] = (),
+                  exclude_dirs: Iterable[str] = ()) -> Iterator[str]:
     """Yield absolute paths of .py files under repo-relative `subdirs`,
-    skipping repo-relative paths in `exclude`."""
+    skipping repo-relative paths in `exclude` and whole repo-relative
+    directory prefixes in `exclude_dirs`."""
     excluded = {e.replace("/", os.sep) for e in exclude}
+    dir_prefixes = tuple(d.rstrip("/").replace("/", os.sep) + os.sep
+                         for d in exclude_dirs)
     for sub in subdirs:
         base = os.path.join(REPO_ROOT, sub)
         for dirpath, _dirnames, filenames in os.walk(base):
@@ -20,7 +24,7 @@ def iter_py_files(*subdirs: str, exclude: Iterable[str] = ()) -> Iterator[str]:
                     continue
                 path = os.path.join(dirpath, fn)
                 rel = os.path.relpath(path, REPO_ROOT)
-                if rel in excluded:
+                if rel in excluded or rel.startswith(dir_prefixes):
                     continue
                 yield path
 
