@@ -227,6 +227,14 @@ def replay_blocks_pipelined(
     failing block's global index — the db-analyser/LgrDB replay semantics
     (OnDisk.hs:277), where any invalid block invalidates the run.
 
+    The two in-flight windows are double-buffered on device: each
+    window's input arrays are donated to its fused program
+    (JaxBackend._window_composite), so on the warm path XLA reuses the
+    previous window's buffers instead of allocating fresh ones, and the
+    cross-window precomputation cache (crypto/precompute.py) means a
+    warm window ships no per-key decompression or table-build work at
+    all — only the ladders themselves.
+
     Falls back to the synchronous windowed driver on backends without
     submit_window."""
     import itertools
@@ -258,10 +266,12 @@ def replay_blocks_pipelined(
             st = res.final_state
         return ReplayResult(st, done, None)
 
+    from collections import deque
+
     from ..crypto.backend import GLOBAL_BETA_CACHE
     # bounded look-ahead: ahead[0] = current window, ahead[1:] = the two
     # windows whose beta proofs may already be in flight
-    ahead: list = []
+    ahead: deque = deque()
     for _ in range(3):
         w = next_window()
         if w is None:
@@ -271,9 +281,7 @@ def replay_blocks_pipelined(
         # windows 0 and 1 ride a plain prefetch; window w's device call
         # then carries window w+2's betas
         protocol.prefetch_window(
-            [h for hs, _w in ahead[:2] for h in hs], backend)
-
-    from collections import deque
+            [h for hs, _w in list(ahead)[:2] for h in hs], backend)
 
     st = ext_state
     # TWO windows in flight: window w's device work has the host passes of
@@ -323,7 +331,7 @@ def replay_blocks_pipelined(
                 for later in pending:
                     backend.finish_window(later[1])
                 return ReplayResult(None, n_ok, err)
-        headers_w, blk_window = ahead.pop(0)
+        headers_w, blk_window = ahead.popleft()
         nxt = next_window()
         if nxt is not None:
             ahead.append(([getattr(b, "header", b) for b in nxt], nxt))

@@ -72,14 +72,11 @@ class CryptoBackend:
         return out
 
     # -- mixed batches --------------------------------------------------------
-    def split_mixed(self, reqs: Sequence):
-        """Host-side split of a mixed request list: KES requests are reduced
-        to their Ed25519 leaf checks (hash-path verification happens here)
-        and merged into the Ed25519 group, so a mixed window costs ONE
-        Ed25519 batch + ONE VRF batch instead of three calls.
-
-        Returns (ed_reqs, ed_owner, vrf_reqs, vrf_owner, n) where owner maps
-        each grouped request back to its index in `reqs`."""
+    def _split_mixed_loop(self, reqs: Sequence, kes_leaf):
+        """Shared dispatch skeleton of the host split variants: group
+        Ed25519/VRF requests, reduce each KES request through
+        `kes_leaf(req) -> (leaf_vk, leaf_sig) | None` (None = the hash
+        path is invalid / known-bad, request stays False)."""
         ed_reqs: list = []
         ed_owner: list[int] = []
         vrf_reqs: list = []
@@ -92,19 +89,63 @@ class CryptoBackend:
                 vrf_reqs.append(r)
                 vrf_owner.append(i)
             elif isinstance(r, KesReq):
-                try:
-                    sig = kes_mod.KesSig.from_bytes(r.depth, r.sig_bytes)
-                except ValueError:
+                leaf = kes_leaf(r)
+                if leaf is None:
                     continue          # stays False
-                prep = kes_mod.verify_prepare(r.depth, r.vk, r.period, sig)
-                if prep is None:
-                    continue
-                leaf_vk, leaf_sig = prep
+                leaf_vk, leaf_sig = leaf
                 ed_reqs.append(Ed25519Req(leaf_vk, r.msg, leaf_sig))
                 ed_owner.append(i)
             else:
                 raise TypeError(f"unknown proof request type {type(r)}")
         return ed_reqs, ed_owner, vrf_reqs, vrf_owner, len(reqs)
+
+    def split_mixed(self, reqs: Sequence):
+        """Host-side split of a mixed request list: KES requests are reduced
+        to their Ed25519 leaf checks (hash-path verification happens here)
+        and merged into the Ed25519 group, so a mixed window costs ONE
+        Ed25519 batch + ONE VRF batch instead of three calls.
+
+        Returns (ed_reqs, ed_owner, vrf_reqs, vrf_owner, n) where owner maps
+        each grouped request back to its index in `reqs`."""
+        def kes_leaf(r):
+            try:
+                sig = kes_mod.KesSig.from_bytes(r.depth, r.sig_bytes)
+            except ValueError:
+                return None
+            return kes_mod.verify_prepare(r.depth, r.vk, r.period, sig)
+        return self._split_mixed_loop(reqs, kes_leaf)
+
+    def split_mixed_cached(self, reqs: Sequence, cache=None):
+        """split_mixed with cross-window KES hash-path memoisation.
+
+        Same return shape as split_mixed, but each KES request's Blake2b
+        Merkle walk is looked up in the precomputation cache first
+        (keyed by kes.hash_path_key — message-independent): warm paths
+        skip the host hashing entirely, cold paths hash once and record
+        the outcome.  The sharded mesh backend threads its windows
+        through this (the single-chip JaxBackend goes further and runs
+        cold paths as device Blake2b jobs — jax_backend.py)."""
+        from .precompute import GLOBAL_PRECOMPUTE_CACHE
+        cache = cache if cache is not None else GLOBAL_PRECOMPUTE_CACHE
+
+        def kes_leaf(r):
+            key = kes_mod.hash_path_key(r.depth, r.vk, r.period,
+                                        r.sig_bytes)
+            if key is None:
+                return None           # structurally invalid
+            ent = cache.kes_get(key)
+            if ent is None:
+                sig = kes_mod.KesSig.from_bytes(r.depth, r.sig_bytes)
+                prep = kes_mod.verify_prepare(r.depth, r.vk, r.period,
+                                              sig)
+                ent = ((prep[0], True) if prep is not None
+                       else (None, False))
+                cache.kes_put(key, *ent)
+            leaf_vk, path_ok = ent
+            if not path_ok:
+                return None           # known-bad hash path
+            return leaf_vk, r.sig_bytes[:64]
+        return self._split_mixed_loop(reqs, kes_leaf)
 
     def verify_mixed(self, reqs: Sequence) -> list[bool]:
         """Verify a mixed Ed25519/VRF/KES request list, preserving order."""

@@ -398,73 +398,13 @@ _B128Y_W = _words_of_int(_B128Y)
 _GX_W = _words_of_int(_GX_AFF)
 
 
-class A128Cache:
-    """vk bytes -> affine words of (A, [2^128]A), with batched device fill.
-
-    assemble() returns ((8, N) uint32 xA-words, x128-words, y128-words,
-    known (N,) bool) for a batch of keys, computing every missing unique
-    key in one a128_kernel call (padded to a power-of-two bucket so
-    repeats hit the jit cache).  `known` is False for keys that failed
-    decompression (not on the curve / bad length) — callers must mask
-    those invalid, since the verify kernels trust the cached x and skip
-    the square-root check entirely."""
-
-    def __init__(self, max_entries: int = 200_000):
-        self._c: dict = {}
-        self.max_entries = max_entries
-
-    def __len__(self):
-        return len(self._c)
-
-    def assemble(self, vks):
-        missing = []
-        seen = set()
-        for vk in vks:
-            if vk in self._c or vk in seen:
-                continue
-            seen.add(vk)
-            missing.append(vk)
-        if missing:
-            self._fill(missing)
-        n = len(vks)
-        xa = np.empty((8, n), dtype=np.uint32)
-        xs = np.empty((8, n), dtype=np.uint32)
-        ys = np.empty((8, n), dtype=np.uint32)
-        known = np.zeros(n, dtype=bool)
-        for j, vk in enumerate(vks):
-            ent = self._c.get(vk)
-            if ent is None:
-                # any valid point works: the lane is masked via `known`
-                xa[:, j], xs[:, j], ys[:, j] = _GX_W, _B128X_W, _B128Y_W
-            else:
-                xa[:, j], xs[:, j], ys[:, j] = ent
-                known[j] = True
-        return xa, xs, ys, known
-
-    def _fill(self, missing) -> None:
-        m = 128
-        while m < len(missing):
-            m *= 2
-        arr, len_ok = _bytes_rows(missing + [b"\x00" * 32] *
-                                  (m - len(missing)), 32)
-        yA, signA, y_ok = _decode_compressed(arr)
-        xa, x, y, ok = a128_kernel(jnp.asarray(yA), jnp.asarray(signA))
-        xai = F.unpack(np.asarray(xa))
-        xi = F.unpack(np.asarray(x))
-        yi = F.unpack(np.asarray(y))
-        ok = np.asarray(ok) & len_ok & y_ok
-        if len(self._c) + len(missing) > self.max_entries:
-            for k in list(self._c)[:len(self._c) // 2]:
-                del self._c[k]
-        for j, vk in enumerate(missing):
-            if ok[j]:
-                self._c[vk] = (_words_of_int(xai[j]), _words_of_int(xi[j]),
-                               _words_of_int(yi[j]))
-            # undecodable keys stay uncached: assemble() fills valid
-            # dummies and flags the lane not-known
-
-
-GLOBAL_A128_CACHE = A128Cache()
+# The per-key [2^128]A cache grew into the cross-window precomputation
+# cache shared by all three primitives (see crypto/precompute.py); the
+# r5 names stay as aliases for the Ed25519-facing entry points.
+from .precompute import (                                     # noqa: E402
+    GLOBAL_PRECOMPUTE_CACHE as GLOBAL_A128_CACHE,
+    PrecomputeCache as A128Cache,
+)
 
 
 def _sq_n(x, n):

@@ -198,6 +198,24 @@ def verify_walk(depth: int, vk: bytes, period: int, sig: KesSig):
     return expect, sig.leaf_sig, jobs
 
 
+def hash_path_key(depth: int, vk: bytes, period: int, sig_bytes: bytes):
+    """Cache identity of a KES signature's hash-path check.
+
+    The Blake2b Merkle walk (verify_walk's jobs AND the leaf vk it ends
+    on) depends only on (depth, period, vk, merkle-path bytes) — NOT on
+    the signed message — so a pool's per-period subtree check has one
+    answer for every header it signs in that period.  The cross-window
+    precomputation cache (crypto/precompute.py) memoises outcomes under
+    this key.  Returns None when the signature is structurally invalid
+    (wrong length / period out of range), which callers reject directly.
+    """
+    if not 0 <= period < total_periods(depth):
+        return None
+    if len(sig_bytes) != 64 + depth * 64:
+        return None
+    return (depth, period, vk, sig_bytes[64:])
+
+
 def verify_prepare(depth: int, vk: bytes, period: int, sig: KesSig):
     """Host-side half of batched verification: check the hash path and
     return the (leaf_vk, leaf_sig) pair for the device Ed25519 batch, or

@@ -442,11 +442,11 @@ def _submit(vks, alphas, proofs, m, runner=None):
     rw, cw, sw — e.g. pallas_kernels.vrf_verify_pallas).  Y's affine x
     is resolved through the global point cache; unknown/bad keys fold
     into parse_ok."""
-    from . import ed25519_jax as _EJ
+    from .precompute import GLOBAL_PRECOMPUTE_CACHE
     args, parse_ok, gamma_ok, s_ok, pf_arr = _prepare_words(vks, alphas,
                                                             proofs)
     Yw, _signY, Gw, signG, rw, cw, sw = args
-    xa, _x128, _y128, known = _EJ.GLOBAL_A128_CACHE.assemble(list(vks))
+    xa, _x128, _y128, known = GLOBAL_PRECOMPUTE_CACHE.assemble(list(vks))
     handle = (runner or _default_runner)(Yw, xa, Gw, signG, rw, cw, sw)
     return handle, parse_ok & known, gamma_ok, s_ok, pf_arr
 

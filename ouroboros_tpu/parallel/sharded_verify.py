@@ -21,6 +21,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..crypto import ed25519_jax as EJ
 from .mesh import WINDOW_AXIS
 
+# jax.shard_map graduated from jax.experimental on newer jax; this tree
+# must run on both (the container jax only ships the experimental name)
+try:
+    _shard_map = jax.shard_map
+except AttributeError:                       # pragma: no cover - jax<0.5
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 
 @functools.lru_cache(maxsize=8)
 def build_sharded_verifier(mesh: Mesh):
@@ -41,7 +48,7 @@ def build_sharded_verifier(mesh: Mesh):
         total = jax.lax.psum(jnp.sum(ok), axis)
         return ok, total
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         step, mesh=mesh,
         in_specs=(spec2, spec1, spec2, spec1, spec2, spec2),
         out_specs=(spec1, P()))
@@ -87,7 +94,7 @@ def build_sharded_vrf(mesh: Mesh):
     axis = mesh.axis_names[0]
     spec2 = P(None, axis)
     spec1 = P(axis)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         vrf_jax.vrf_verify_core, mesh=mesh,
         in_specs=(spec2, spec1, spec2, spec1, spec2, spec2, spec2, spec2),
         out_specs=P(axis, None))
@@ -98,7 +105,7 @@ def build_sharded_vrf(mesh: Mesh):
 def build_sharded_gamma8(mesh: Mesh):
     from ..crypto import vrf_jax
     axis = mesh.axis_names[0]
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         vrf_jax.gamma8_kernel.__wrapped__, mesh=mesh,
         in_specs=(P(None, axis), P(axis)),
         out_specs=P(axis, None))
@@ -120,13 +127,25 @@ class ShardedJaxBackend(CryptoBackend):
     over the window axis, packing all results into ONE flat uint8 array —
     one launch and one host transfer per window regardless of mesh size
     (VERDICT r3 next-step 5; on a tunneled or multi-host link the fixed
-    per-dispatch latency dominates exactly as on one chip)."""
+    per-dispatch latency dominates exactly as on one chip).
+
+    Cross-window precomputation cache threading: KES hash-path outcomes
+    ride the shared cache (split_mixed_cached — one host Merkle walk per
+    (pool, period) per process), and window input buffers are donated on
+    real accelerators.  The Ed25519/VRF POINT entries are not consumed
+    here yet: these mesh kernels run the bit-rows form and decompress on
+    device; moving them to the packed-words/cached-x kernels (the
+    single-chip forms) is the remaining step to key-free warm windows on
+    a mesh."""
 
     def __init__(self, mesh: Mesh, min_bucket: int = 128):
         self.mesh = mesh
         self.name = f"jax-mesh-{mesh.devices.size}"
         self.min_bucket = min_bucket
         self._composites: dict = {}      # (ne, nv, nb) -> fused program
+        # buffer donation for the per-window inputs (see JaxBackend):
+        # fresh arrays every window, never read back -> donation-safe
+        self._donate = mesh.devices.flat[0].platform in ("tpu", "gpu")
 
     def _pad(self, n: int) -> int:
         d = self.mesh.devices.size
@@ -204,16 +223,16 @@ class ShardedJaxBackend(CryptoBackend):
         spec2 = P(None, axis)
         spec1 = P(axis)
 
-        ed_mapped = jax.shard_map(
+        ed_mapped = _shard_map(
             EJ.verify_full_core, mesh=mesh,
             in_specs=(spec2, spec1, spec2, spec1, spec2, spec2),
             out_specs=spec1) if ne else None
-        vrf_mapped = jax.shard_map(
+        vrf_mapped = _shard_map(
             vrf_jax.vrf_verify_core, mesh=mesh,
             in_specs=(spec2, spec1, spec2, spec1, spec2, spec2, spec2,
                       spec2),
             out_specs=P(axis, None)) if nv else None
-        beta_mapped = jax.shard_map(
+        beta_mapped = _shard_map(
             vrf_jax.gamma8_kernel.__wrapped__, mesh=mesh,
             in_specs=(spec2, spec1),
             out_specs=P(axis, None)) if nb else None
@@ -233,7 +252,8 @@ class ShardedJaxBackend(CryptoBackend):
                 parts.append(beta_mapped(byG, bsG2[0]).reshape(-1))
             return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
-        fn = jax.jit(call)
+        fn = jax.jit(call, donate_argnums=(0, 1, 2)) if self._donate \
+            else jax.jit(call)
         self._composites[key] = fn
         return fn
 
@@ -242,7 +262,11 @@ class ShardedJaxBackend(CryptoBackend):
         prep, same packed result layout, batches sharded over the window
         axis.  Returns the opaque state finish_window consumes."""
         from ..crypto import vrf_jax
-        ed_reqs, ed_owner, vrf_reqs, vrf_owner, n = self.split_mixed(reqs)
+        # KES hash paths reduce on host here, but through the cross-
+        # window outcome cache: a pool's per-period Merkle walk is
+        # hashed once per process, not once per signature
+        ed_reqs, ed_owner, vrf_reqs, vrf_owner, n = \
+            self.split_mixed_cached(reqs)
         beta_proofs = list(dict.fromkeys(next_beta_proofs))
         ed_state = vrf_state = beta_state = None
         ne = nv = nb = 0
@@ -298,9 +322,10 @@ class ShardedJaxBackend(CryptoBackend):
                 "vrf": vrf_state, "vrf_owner": vrf_owner,
                 "vrf_n": len(vrf_reqs), "nv": nv,
                 "beta": beta_state, "beta_proofs": beta_proofs, "nb": nb,
-                # KES hash paths are reduced on host here (base
-                # split_mixed); keys kept for the shared finish_window
-                "kes_job_owner": [], "nk": 0, "kes_n": 0}
+                # KES hash paths are reduced on host here
+                # (split_mixed_cached); keys kept for the shared
+                # finish_window
+                "kes_checks": [], "nk": 0, "kes_n": 0}
 
     # identical packed layout -> identical host-side unpacking
     from ..crypto.jax_backend import JaxBackend as _JB

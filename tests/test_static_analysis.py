@@ -297,6 +297,56 @@ def test_jax005_lambda_into_jitted_callable_fires():
     assert "JAX005" in _rules(deco)
 
 
+def test_jax006_jit_in_loop_fires():
+    f = jax_lint(
+        "import jax\n"
+        "def per_window(windows):\n"
+        "    out = []\n"
+        "    for w in windows:\n"
+        "        fn = jax.jit(lambda y: y + 1)\n"
+        "        out.append(fn(w))\n"
+        "    return out\n", "fx.py")
+    assert "JAX006" in _rules(f)
+    # while loops and pallas_call/shard_map constructions count too
+    f2 = jax_lint(
+        "from jax.experimental import pallas as pl\n"
+        "def reps(k, x):\n"
+        "    while k:\n"
+        "        x = pl.pallas_call(kernel, out_shape=x)(x)\n"
+        "        k -= 1\n"
+        "    return x\n", "fx.py")
+    assert "JAX006" in _rules(f2)
+
+
+def test_jax006_hoisted_and_memoised_builders_allowed():
+    # calling an ALREADY-built jit in a loop is the intended pattern
+    good = jax_lint(
+        "import jax\n"
+        "fast = jax.jit(lambda y: y + 1)\n"
+        "def per_window(windows):\n"
+        "    return [fast(w) for w in windows]\n", "fx.py")
+    assert "JAX006" not in _rules(good)
+    # a def nested inside a loop runs at call time, not per iteration
+    nested = jax_lint(
+        "import jax\n"
+        "def outer(items):\n"
+        "    for it in items:\n"
+        "        def later():\n"
+        "            return jax.jit(lambda y: y)\n"
+        "        use(later)\n", "fx.py")
+    assert "JAX006" not in _rules(nested)
+
+
+def test_jax_pass_scans_bench_script():
+    """bench.py's per-rep loops are in scope for the retrace-hazard rule
+    (SCAN_DIRS includes the top-level script)."""
+    from tools.analysis.jax_pass import SCAN_DIRS, run
+    assert "bench.py" in SCAN_DIRS
+    findings = run()
+    assert not [f for f in findings if f.rule == "JAX006"], (
+        "live tree must stay free of jit-in-loop constructions")
+
+
 def test_branch_enforces_declared_targets_at_runtime():
     from ouroboros_tpu.network.typed import ProtocolError
     good = branch(lambda m: "B" if m else "C", "B", "C")

@@ -111,6 +111,30 @@ def test_shelley_replay_backend_parity(shelley_db):
             == json.loads(r2.stdout)["state_hash"])
 
 
+@pytest.mark.device
+def test_bench_smoke_parity_gate():
+    """`bench --smoke` in-process: the tier-1 guard that keeps the
+    replay hot path honest between bench rounds — tiny synth chain, one
+    JAX replay vs the CPU baseline (state-hash parity + cross-window
+    key reuse) and a cold+warm corrupted mixed batch (verdict parity +
+    zero warm-path fill dispatches).  No timing assertions (that is the
+    real bench's job on real hardware)."""
+    pytest.importorskip("jax")
+    sys.path.insert(0, REPO)
+    import bench
+    res = bench.smoke()
+    assert res["state_hash_parity"] and res["verdict_parity"]
+    assert res["warm_device_fills"] == 0 and res["warm_kes_jobs"] == 0
+    assert res["blocks"] == 8
+
+
+def test_bench_cli_flags_exist():
+    """--smoke/--retune are wired (driver + CI call them blind)."""
+    r = _run("bench.py", "--help")
+    assert r.returncode == 0, r.stderr
+    assert "--smoke" in r.stdout and "--retune" in r.stdout
+
+
 def test_shelley_replay_detects_tamper(shelley_db, tmp_path):
     import shutil
     bad = str(tmp_path / "badsh")

@@ -20,10 +20,17 @@ retrace hazards inside those bodies:
 - JAX005 lambda-to-jit: a known-jitted callable invoked with an inline
   lambda argument — a fresh function object per call, so the jit cache
   can never hit (and a tracer error unless marked static).
+- JAX006 jit-in-loop: jax.jit / shard_map / pallas_call CONSTRUCTED
+  lexically inside a for/while loop — a per-window or per-rep kernel
+  rebuild, the retrace hazard behind BENCH_r05's mid-bench retunes.
+  Memoised builders called from loops are fine; building the wrapper in
+  the loop body never is.
 
 The traced-set computation is deliberately same-module only: cross-module
 calls (e.g. field_jax helpers) are linted in their own module when they
 are jitted/traced there, which keeps the pass O(files) with no import cost.
+The scan covers crypto/, parallel/ and the top-level bench.py (the
+per-rep loops the JAX006 hazard lives in).
 """
 from __future__ import annotations
 
@@ -33,7 +40,7 @@ from typing import Dict, Iterable, List, Optional, Set
 from . import Finding, register, relpath
 from .astutil import QualnameVisitor, dotted_name, iter_py_files, parse_file
 
-SCAN_DIRS = ("ouroboros_tpu/crypto", "ouroboros_tpu/parallel")
+SCAN_DIRS = ("ouroboros_tpu/crypto", "ouroboros_tpu/parallel", "bench.py")
 
 _JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
 # Calls whose function-valued arguments are traced when invoked.
@@ -52,6 +59,11 @@ _TRACING_CALLS = {
 }
 _CACHE_DECORATORS = {"functools.lru_cache", "lru_cache",
                      "functools.cache", "cache"}
+# kernel-wrapper constructions JAX006 watches inside loop bodies
+_KERNEL_BUILDERS = _JIT_NAMES | {
+    "jax.shard_map", "shard_map",
+    "pl.pallas_call", "pltpu.pallas_call", "pallas_call",
+}
 _STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
 
 
@@ -210,8 +222,9 @@ class _TracedBodyLint(QualnameVisitor):
 
 
 class _JitPerCallLint(QualnameVisitor):
-    """Flags JAX004 (jit built per call) and JAX005 (lambda into a jitted
-    callable) over the whole module."""
+    """Flags JAX004 (jit built per call), JAX005 (lambda into a jitted
+    callable) and JAX006 (kernel wrapper built inside a loop) over the
+    whole module."""
 
     def __init__(self, file: str, findings: List[Finding],
                  jitted_names: Set[str]):
@@ -221,15 +234,31 @@ class _JitPerCallLint(QualnameVisitor):
         self.jitted_names = jitted_names
         self._cached_depth = 0
         self._fn_depth = 0
+        self._loop_depth = 0
+
+    def _visit_loop(self, node):
+        self._loop_depth += 1
+        try:
+            self.generic_visit(node)
+        finally:
+            self._loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
 
     def _visit_scope(self, node):
         cached = any(_decorator_caches(d) for d in node.decorator_list)
         self._cached_depth += cached
         self._fn_depth += isinstance(
             node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        # a def nested in a loop runs at CALL time, not per iteration:
+        # its body starts from loop depth 0
+        outer_loops, self._loop_depth = self._loop_depth, 0
         try:
             QualnameVisitor._visit_scope(self, node)
         finally:
+            self._loop_depth = outer_loops
             self._cached_depth -= cached
             self._fn_depth -= isinstance(
                 node, (ast.FunctionDef, ast.AsyncFunctionDef))
@@ -244,6 +273,12 @@ class _JitPerCallLint(QualnameVisitor):
 
     def visit_Call(self, node: ast.Call):
         name = _call_name(node)
+        if name in _KERNEL_BUILDERS and self._loop_depth > 0:
+            self._add(node, "JAX006",
+                      f"{name}() constructed inside a loop body rebuilds "
+                      f"the kernel wrapper every iteration (per-window/"
+                      f"per-rep retrace hazard); hoist the construction "
+                      f"out of the loop or memoise the builder")
         if name in _JIT_NAMES:
             if self._fn_depth > 0 and self._cached_depth == 0:
                 self._add(node, "JAX004",
