@@ -90,6 +90,45 @@ def check_spread(name, vals):
     return med, spread
 
 
+# the span phase vocabulary IS the bench phase schema — import it so a
+# category added in observe/spans.py cannot silently fold into `other`
+from ouroboros_tpu.observe.spans import PHASES as PHASE_ORDER  # noqa: E402
+
+
+def _rep_phase_totals(observe, roots, rep_secs: float) -> dict:
+    """One timed rep's seconds per phase from its drained span forest.
+    `other` is the rep wall time no span claimed (host work outside the
+    instrumented seams — result folding, python overhead)."""
+    totals = observe.phase_totals(roots)
+    out = {ph: round(totals.get(ph, 0.0), 4) for ph in PHASE_ORDER}
+    claimed = sum(totals.values())
+    out["other"] = round(max(0.0, rep_secs - claimed), 4)
+    return out
+
+
+def _phase_variance(rep_phases) -> dict:
+    """Cross-rep stats per phase + the phase with the largest spread.
+
+    Ranked by ABSOLUTE spread (max-min seconds): the phase contributing
+    the most wall-clock variance to the rep totals — a ~0s phase with
+    big relative jitter must not outrank the phase that actually moved
+    the median (the BENCH_r05 '45% vrf spread' diagnosis, attributed)."""
+    if not rep_phases:
+        return {}
+    per_phase = {}
+    for ph in list(PHASE_ORDER) + ["other"]:
+        vals = [d.get(ph, 0.0) for d in rep_phases]
+        med, spread = median_spread(vals)
+        per_phase[ph] = {"median": round(med, 4),
+                         "min": round(min(vals), 4),
+                         "max": round(max(vals), 4),
+                         "spread_secs": round(max(vals) - min(vals), 4),
+                         "spread_rel": round(spread, 3)}
+    dominant = max(per_phase, key=lambda p: per_phase[p]["spread_secs"])
+    return {"per_phase": per_phase, "dominant_phase": dominant,
+            "dominant_spread_secs": per_phase[dominant]["spread_secs"]}
+
+
 def previous_bench():
     """Latest recorded BENCH_r*.json, for the primitives-vs-previous-round
     comparison the bench prints itself (VERDICT r3 next-step 1e)."""
@@ -344,7 +383,7 @@ def _smoke_verdict_parity(jb):
         [e.vk for e in eds]
     GLOBAL_PRECOMPUTE_CACHE.assemble(point_vks)
     warm_fills = GLOBAL_PRECOMPUTE_CACHE.device_fills - fills
-    return (got == want, warm_fills, len(kes_msgs) + len(checks))
+    return (got == want, warm_fills, len(kes_msgs) + len(checks), reqs)
 
 
 def smoke(blocks: int = 8, window: int = 8):
@@ -380,7 +419,10 @@ def smoke(blocks: int = 8, window: int = 8):
         # (ed window, vrf window) — more means the cache is not reused
         replay_fills = GLOBAL_PRECOMPUTE_CACHE.device_fills - fills0
         hash_ok = cpu_hash == jax_hash
-        verdict_ok, warm_fills, warm_jobs = _smoke_verdict_parity(jb)
+        verdict_ok, warm_fills, warm_jobs, parity_reqs = \
+            _smoke_verdict_parity(jb)
+        snapshot_ok, disabled_writes, disabled_spans = \
+            _smoke_observe(jb, parity_reqs)
         result = {"metric": "bench_smoke", "value": 1.0,
                   "blocks": len(blocks_l), "proofs": n_proofs,
                   "state_hash_parity": bool(hash_ok),
@@ -388,9 +430,14 @@ def smoke(blocks: int = 8, window: int = 8):
                   "replay_fill_dispatches": int(replay_fills),
                   "warm_device_fills": int(warm_fills),
                   "warm_kes_jobs": int(warm_jobs),
+                  "observe_snapshot_parses": bool(snapshot_ok),
+                  "disabled_registry_writes": int(disabled_writes),
+                  "disabled_spans_recorded": int(disabled_spans),
                   "precompute": GLOBAL_PRECOMPUTE_CACHE.stats()}
         if not (hash_ok and verdict_ok and warm_fills == 0
-                and warm_jobs == 0 and replay_fills <= 3):
+                and warm_jobs == 0 and replay_fills <= 3
+                and snapshot_ok and disabled_writes == 0
+                and disabled_spans == 0):
             result["value"] = 0.0
             print(json.dumps(result))
             raise SystemExit(f"bench --smoke parity failure: {result}")
@@ -399,6 +446,49 @@ def smoke(blocks: int = 8, window: int = 8):
     finally:
         BLOCKS, TXS, EPOCH_LEN = old
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _smoke_observe(jb, probe_reqs):
+    """Observability gates for --smoke (ISSUE 7 acceptance):
+
+    1. the registry snapshot round-trips (deterministic JSON) and the
+       Prometheus exposition re-parses — the export path is never the
+       thing that breaks between bench rounds;
+    2. with observation DISABLED, a fully instrumented window performs
+       ZERO gated registry writes and records zero spans (the NOP fast
+       path actually is one).
+
+    `probe_reqs` must be a batch whose window shape is ALREADY compiled
+    (the verdict-parity batch): the probe may not spend a fresh XLA:CPU
+    composite compile inside the tier-1 budget.
+
+    Returns (snapshot_ok, disabled_writes, disabled_spans)."""
+    from ouroboros_tpu import observe
+    reg = observe.metrics.registry()
+    rec = observe.spans.RECORDER
+    try:
+        snap = json.loads(reg.snapshot_json())
+        prom = observe.export.parse_prometheus_text(
+            observe.export.prometheus_text(reg))
+        snapshot_ok = isinstance(snap, dict) and len(prom) >= len(snap)
+    except Exception as e:
+        log(f"observe snapshot failed to parse: {e!r}")
+        snapshot_ok = False
+    # the disabled-observation probe: run an instrumented hot-path
+    # window (spans + gated counters on every seam) with everything off
+    was_reg, was_rec = reg.enabled, rec.enabled
+    reg.disable()
+    rec.disable()
+    try:
+        writes0, roots0 = reg.data_writes, len(rec.roots)
+        jb.verify_mixed(probe_reqs)
+        with observe.span("probe", cat="sync"):
+            pass
+        disabled_writes = reg.data_writes - writes0
+        disabled_spans = len(rec.roots) - roots0
+    finally:
+        reg.enabled, rec.enabled = was_reg, was_rec
+    return snapshot_ok, disabled_writes, disabled_spans
 
 
 def _clear_beta_cache():
@@ -454,15 +544,30 @@ def main():
         replay(rules, blocks, jb, WINDOW)
         warm_fills = GLOBAL_PRECOMPUTE_CACHE.device_fills
         tpu_times, dev_times = [], []
+        rep_phases: list = []
         tpu_hash = None
+        # per-rep phase attribution (ISSUE 7): spans on for the timed
+        # reps only — each rep yields sync/compile/dispatch/device/
+        # host-seq totals, so a spread warning names the phase that
+        # moved instead of leaving a bare 45% number
+        from ouroboros_tpu import observe
+        observe.spans.RECORDER.enable()
         autotune.freeze_all()   # any mid-bench retune now raises
         try:
             for _ in range(REPS):
                 jb.device_secs = 0.0
                 GLOBAL_BETA_CACHE.clear()
+                with observe.span("rep.fence", cat="sync", fence=True):
+                    pass        # drain in-flight dispatches pre-rep
+                # discard pre-rep spans (the fence above ran OUTSIDE the
+                # timed rep — attributing it would make phases sum past
+                # rep_secs and under-report `other`)
+                observe.spans.RECORDER.drain()
                 secs, tpu_hash, _ = replay(rules, blocks, jb, WINDOW)
                 tpu_times.append(secs)
                 dev_times.append(jb.device_secs)
+                rep_phases.append(_rep_phase_totals(
+                    observe, observe.spans.RECORDER.drain(), secs))
         except autotune.FrozenAutotunerError as e:
             raise SystemExit(
                 f"mid-bench retune attempt inside a timed replay rep "
@@ -471,6 +576,7 @@ def main():
                 f"trustworthy") from e
         finally:
             autotune.thaw_all()
+            observe.spans.RECORDER.disable()
         assert tpu_hash == cpu_hash, "state hash parity violated"
         warm_extra_fills = (GLOBAL_PRECOMPUTE_CACHE.device_fills
                             - warm_fills)
@@ -486,6 +592,13 @@ def main():
             f"{len(blocks) / tpu_secs:.0f} blocks/s); "
             f"device+dispatch {dev_secs:.2f}s / "
             f"host-seq {tpu_secs - dev_secs:.2f}s")
+        variance = _phase_variance(rep_phases)
+        if variance:
+            dom = variance["dominant_phase"]
+            log(f"variance: largest cross-rep spread in phase '{dom}' "
+                f"({variance['dominant_spread_secs']:.2f}s min->max; "
+                f"per-phase "
+                f"{ {p: v['spread_secs'] for p, v in variance['per_phase'].items()} })")
 
         prim = bench_primitives(JaxBackend())
         log(f"primitives: {prim}")
@@ -517,6 +630,9 @@ def main():
             "breakdown": {
                 "device_secs": round(dev_secs, 3),
                 "host_secs": round(tpu_secs - dev_secs, 3)},
+            "phases": rep_phases,
+            "variance": variance,
+            "metrics": observe.metrics.registry().snapshot(),
             "kernel_choices": {
                 "@".join(str(p) for p in k): ("pallas" if v else "xla")
                 for k, v in jb._inner.kernel_choices.items()},

@@ -22,6 +22,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
 
 from ..crypto.backend import CryptoBackend, default_backend
+from ..observe import spans as _spans
 from .header_validation import (
     HeaderError, HeaderState, validate_envelope, revalidate_header,
 )
@@ -339,20 +340,23 @@ def replay_blocks_pipelined(
         owner: list[int] = []
         seq_error: Optional[Exception] = None
         n_seq_w = 0
-        for i, b in enumerate(blk_window):
-            try:
-                rs, st = _seq_block_step(protocol, ledger, st, b)
-            except OutsideForecastRange as e:
-                # retry-later, never invalid (see validate_blocks_batched)
-                seq_error = e
-                break
-            except Exception as e:
-                seq_error = (e if isinstance(e, (HeaderError, LedgerError))
-                             else LedgerError(str(e)))
-                break
-            reqs.extend(rs)
-            owner.extend([i] * len(rs))
-            n_seq_w += 1
+        with _spans.span("window.host_seq", cat="host-seq"):
+            for i, b in enumerate(blk_window):
+                try:
+                    rs, st = _seq_block_step(protocol, ledger, st, b)
+                except OutsideForecastRange as e:
+                    # retry-later, never invalid (see
+                    # validate_blocks_batched)
+                    seq_error = e
+                    break
+                except Exception as e:
+                    seq_error = (e if isinstance(e, (HeaderError,
+                                                     LedgerError))
+                                 else LedgerError(str(e)))
+                    break
+                reqs.extend(rs)
+                owner.extend([i] * len(rs))
+                n_seq_w += 1
 
         # carry betas for the window TWO ahead (ahead[1] after the pop):
         # they are fetched at drain time, which precedes that window's

@@ -30,6 +30,11 @@ import json
 import os
 import sys
 import time
+from dataclasses import dataclass
+
+from ..observe import metrics as _metrics
+from ..observe import spans as _spans
+from ..utils.tracer import Tracer
 
 # bump when kernel internals change enough that a persisted pallas-vs-XLA
 # choice could be stale (the choices file is keyed by this revision)
@@ -37,6 +42,31 @@ KERNEL_REV = "r6-precompute-1"
 
 WARMUP_REPS = 1
 TIMED_REPS = 3
+
+# registry counters (ISSUE 7).  frozen_writes is load-bearing (bench
+# asserts it stays 0 across timed regions) -> always.  measurements and
+# stores depend on what an earlier process persisted, so they are
+# excluded from the deterministic snapshot (stable=False) but still
+# exported to Prometheus.
+_FROZEN_WRITES = _metrics.counter("autotune.frozen_writes", always=True)
+_MEASUREMENTS = _metrics.counter("autotune.measurements", always=True,
+                                 stable=False)
+_STORES = _metrics.counter("autotune.stores", always=True, stable=False)
+
+
+@dataclass(frozen=True)
+class AutotuneMeasured:
+    """One head-to-head pallas-vs-XLA measurement (the typed decision
+    event; TRACER forwards it to whoever is listening)."""
+    device_kind: str
+    key: tuple
+    pallas_ms: float
+    xla_ms: float
+    use_pallas: bool
+
+
+# decision event sink — NOP unless a test/exporter attaches one
+TRACER = Tracer()
 
 
 class FrozenAutotunerError(RuntimeError):
@@ -144,6 +174,7 @@ class Autotuner:
     def _store_choice(self, key, use: bool, timings=None) -> None:
         if self.frozen:
             self.writes_while_frozen += 1
+            _FROZEN_WRITES.inc()
             raise FrozenAutotunerError(
                 f"kernel choice for {key} written inside a timed region "
                 f"(autotuner frozen); pin all shapes in a warmup phase "
@@ -151,6 +182,7 @@ class Autotuner:
         self._choices[key] = bool(use)
         if timings is not None:
             self._timings[key] = timings
+        _STORES.inc()
         self._save()
 
     def put_derived(self, key, use: bool) -> None:
@@ -169,19 +201,28 @@ class Autotuner:
         if self.frozen:
             # raise through _store_choice for a single error site
             self._store_choice(key, False)
+        _MEASUREMENTS.inc()
         best = {}
         last = {}
-        for flag, fn in ((True, run_pallas), (False, run_xla)):
-            for _ in range(WARMUP_REPS):
-                fn()                                # warm / compile
-            vals = []
-            for _ in range(TIMED_REPS):
-                _fence()
-                t0 = time.perf_counter()
-                last[flag] = fn()
-                vals.append(time.perf_counter() - t0)
-            best[flag] = min(vals)
+        # compile phase: a measurement is shape-pinning work that must
+        # never overlap a timed region, so the whole warm+measure block
+        # is one fenced compile span (cold-path only — a pinned choice
+        # returns from get() without ever reaching here)
+        with _spans.span("autotune.measure", cat="compile", fence=True):
+            for flag, fn in ((True, run_pallas), (False, run_xla)):
+                for _ in range(WARMUP_REPS):
+                    fn()                            # warm / compile
+                vals = []
+                for _ in range(TIMED_REPS):
+                    _fence()
+                    t0 = time.perf_counter()
+                    last[flag] = fn()
+                    vals.append(time.perf_counter() - t0)
+                best[flag] = min(vals)
         use = best[True] <= best[False]
+        TRACER.trace(AutotuneMeasured(
+            self.device_kind, key, round(best[True] * 1e3, 3),
+            round(best[False] * 1e3, 3), use))
         print(f"[autotune:{self.device_kind}] {key}: "
               f"pallas {best[True] * 1e3:.0f}ms / "
               f"xla {best[False] * 1e3:.0f}ms (min of {TIMED_REPS}) -> "

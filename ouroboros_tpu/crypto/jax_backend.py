@@ -34,6 +34,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..observe import metrics as _metrics
+from ..observe import spans as _spans
 from . import autotune as autotune_mod
 from . import blake2b_jax as B2
 from . import ed25519_jax as EJ
@@ -41,6 +43,26 @@ from . import edwards as ed
 from . import kes as kes_mod
 from .backend import CryptoBackend, Ed25519Req, KesReq, VrfReq
 from .precompute import GLOBAL_PRECOMPUTE_CACHE
+
+# observational (gated) counters: window/dispatch volume on the hot path
+_WINDOWS = _metrics.counter("jax_backend.windows_submitted")
+_COMPOSITE_BUILDS = _metrics.counter("jax_backend.composite_builds")
+
+
+def _compile_span_on_first_call(fn, name: str):
+    """Wrap a jitted program so its FIRST invocation — the one paying
+    XLA trace+compile — runs inside a `compile` span.  Later calls go
+    straight through: steady-state dispatch must not be attributed to
+    compile (and costs one list lookup when observation is off)."""
+    pending = [True]
+
+    def run(*a):
+        if pending:
+            pending.clear()
+            with _spans.span(name, cat="compile"):
+                return fn(*a)
+        return fn(*a)
+    return run
 
 
 def _bucket(n: int, lo: int = 128) -> int:
@@ -406,6 +428,9 @@ class JaxBackend(CryptoBackend):
         # every window.  CPU ignores donation (warns), hence the gate.
         fn = jax.jit(call, donate_argnums=(0, 1, 2, 3)) if self._donate \
             else jax.jit(call)
+        _COMPOSITE_BUILDS.inc()
+        fn = _compile_span_on_first_call(
+            fn, f"window.composite({ne},{nv},{nb},{nk})")
         self._composites[key] = fn
         return fn
 
@@ -417,9 +442,14 @@ class JaxBackend(CryptoBackend):
         latency-bound host<->device link is crossed once per window, and
         the launch overhead is paid once instead of per kernel.  Returns
         an opaque state for finish_window."""
+        with _spans.span("window.submit", cat="dispatch"):
+            return self._submit_window(reqs, next_beta_proofs)
+
+    def _submit_window(self, reqs, next_beta_proofs=()):
         import jax.numpy as jnp
 
         from . import vrf_jax
+        _WINDOWS.inc()
         (ed_reqs, ed_owner, vrf_reqs, vrf_owner,
          kes_msgs, kes_expects, kes_checks, n) = \
             self._split_mixed_device(reqs)
@@ -525,7 +555,8 @@ class JaxBackend(CryptoBackend):
         betas: dict = {}
         if state["packed"] is None:
             return out, betas
-        flat = np.asarray(state["packed"])          # THE round trip
+        with _spans.span("window.drain", cat="device"):
+            flat = np.asarray(state["packed"])      # THE round trip
         off = 0
         if state["ed"] is not None:
             ed_ok = flat[off:off + state["ne"]]

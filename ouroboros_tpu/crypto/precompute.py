@@ -29,12 +29,24 @@ kernel every window just to re-discover it cannot be decompressed.
 Import discipline: this module must import WITHOUT jax (backend.py and
 host-only tooling read the KES namespace); the device fill imports
 ed25519_jax lazily inside `_fill`.
+
+Counters live in the observability registry (ISSUE 7): the process-wide
+cache registers its hit/miss/device_fill/eviction counters under the
+`precompute.*` namespace so metrics snapshots, the Prometheus
+exposition and the bench JSON all read ONE source of truth — while the
+original attribute names (`cache.hits`, `cache.device_fills += 1`, ...)
+keep working as read/write property aliases, so every existing
+assertion and call site is untouched.  Per-instance caches (tests)
+carry private unregistered counters with the same API.
 """
 from __future__ import annotations
 
 from collections import OrderedDict
 
 import numpy as np
+
+from ..observe import metrics as _metrics
+from ..observe import spans as _spans
 
 # sentinel stored for keys whose decompression failed: assemble() keeps
 # reporting known=False for them without re-dispatching the fill kernel
@@ -59,17 +71,41 @@ class PrecomputeCache:
     (the r5 ancestor dropped the oldest half in insertion order, which
     could evict keys touched every window)."""
 
-    def __init__(self, max_entries: int = 200_000):
+    # counter names in the registry namespace (ISSUE 7); the attribute
+    # aliases below expose each as plain read/write ints
+    _COUNTERS = ("hits", "misses", "device_fills", "filled_keys",
+                 "evictions")
+
+    def __init__(self, max_entries: int = 200_000, register: bool = False):
         self._c: OrderedDict = OrderedDict()    # vk -> (xa, x128, y128)|_BAD
         self._kes: OrderedDict = OrderedDict()  # hash_path_key -> (leaf_vk, ok)
         self.max_entries = max_entries
         # counters: the warm-path contract is `device_fills`/`filled_keys`
-        # flat across a warm window (zero per-key device work)
-        self.hits = 0
-        self.misses = 0
-        self.device_fills = 0      # fill-kernel dispatches
-        self.filled_keys = 0       # keys computed on device
-        self.evictions = 0
+        # flat across a warm window (zero per-key device work).  They are
+        # `always` instruments — load-bearing program state asserted by
+        # bench/tests, counted whether or not observation is enabled —
+        # and only the process-wide cache binds them into the global
+        # registry (per-instance caches in tests stay private).
+        mk = ((lambda n: _metrics.counter(n, always=True)) if register
+              else (lambda n: _metrics.Counter(n, always=True)))
+        self._counters = {name: mk(f"precompute.{name}")
+                          for name in self._COUNTERS}
+
+    # -- counter aliases (the pre-registry accessor names, kept) ------------
+    def _alias(name):  # noqa: N805 — descriptor factory, not a method
+        def _get(self):
+            return self._counters[name].value
+
+        def _set(self, v):
+            self._counters[name].value = v
+        return property(_get, _set)
+
+    hits = _alias("hits")
+    misses = _alias("misses")
+    device_fills = _alias("device_fills")
+    filled_keys = _alias("filled_keys")
+    evictions = _alias("evictions")
+    del _alias
 
     def __len__(self):
         return len(self._c)
@@ -134,10 +170,12 @@ class PrecomputeCache:
         yA, signA, y_ok = EJ._decode_compressed(arr)
         self.device_fills += 1
         self.filled_keys += len(missing)
-        xa, x, y, ok = EJ.a128_kernel(jnp.asarray(yA), jnp.asarray(signA))
-        xai = F.unpack(np.asarray(xa))
-        xi = F.unpack(np.asarray(x))
-        yi = F.unpack(np.asarray(y))
+        with _spans.span("precompute.fill", cat="device"):
+            xa, x, y, ok = EJ.a128_kernel(jnp.asarray(yA),
+                                          jnp.asarray(signA))
+            xai = F.unpack(np.asarray(xa))
+            xi = F.unpack(np.asarray(x))
+            yi = F.unpack(np.asarray(y))
         ok = np.asarray(ok) & len_ok & y_ok
         fresh: dict = {}
         for j, vk in enumerate(missing):
@@ -190,5 +228,6 @@ class PrecomputeCache:
 
 # one process-wide cache: every backend instance (single-chip, sharded)
 # and both primitives' host preps share it, so a key warmed by any path
-# stays warm for all of them
-GLOBAL_PRECOMPUTE_CACHE = PrecomputeCache()
+# stays warm for all of them.  Its counters are the registry's
+# `precompute.*` metrics.
+GLOBAL_PRECOMPUTE_CACHE = PrecomputeCache(register=True)

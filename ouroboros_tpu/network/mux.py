@@ -16,7 +16,10 @@ from dataclasses import dataclass
 from typing import Optional
 
 from .. import simharness as sim
+from ..observe import metrics as _metrics
 from ..simharness import TBQueue, TVar, retry
+
+_TEARDOWNS = _metrics.counter("mux.teardowns")
 
 INITIATOR, RESPONDER = 0, 1
 HEADER = struct.Struct(">IHH")   # timestamp, mode|num, length
@@ -202,6 +205,8 @@ class Mux:
             j.cancel()
 
     def _mark_closed(self) -> None:
+        if not self._closed.value:     # count each mux teardown once
+            _TEARDOWNS.inc()
         try:
             self._closed.set_notify(True)
         except Exception:

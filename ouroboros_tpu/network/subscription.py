@@ -19,7 +19,17 @@ from dataclasses import dataclass
 from typing import Callable, Dict, Optional, Sequence
 
 from .. import simharness as sim
+from ..observe import metrics as _metrics
 from .error_policy import ErrorPolicy, SuspendDecision, eval_error_policies
+
+# process-wide reconnect/suspension counters (ISSUE 7): the registry
+# replaces grepping sim traces for dial/suspend tuples.  Gated writes —
+# int bumps, invisible to sim determinism (no clock, no RNG).
+_DIALS = _metrics.counter("subscription.dials")
+_RECONNECTS = _metrics.counter("subscription.reconnects")
+_CLEAN_ENDS = _metrics.counter("subscription.clean_ends")
+_SUSPENSIONS = _metrics.counter("subscription.suspensions")
+_FATALS = _metrics.counter("subscription.fatals")
 
 
 class Resolver:
@@ -159,6 +169,7 @@ class PeerState:
     consumer_until: float = 0.0
     peer_until: float = 0.0
     connected: bool = False
+    dials: int = 0            # lifetime dial count (dials>1 = reconnect)
 
     @property
     def suspended_until(self) -> float:
@@ -237,6 +248,7 @@ class SubscriptionWorker:
             st.fail_count = 0
             st.consumer_until = now + self._backoff(self.base_backoff, 0)
             self.trace.append((now, "conn-end", addr, None))
+            _CLEAN_ENDS.inc()
             sim.trace_event((self.label, "conn-end-clean", addr),
                             label="subscription")
             return
@@ -246,6 +258,7 @@ class SubscriptionWorker:
         if verdict.kind == "throw":
             # fatal: surface to the application instead of converting the
             # verdict into a quiet backoff window
+            _FATALS.inc()
             sim.trace_event((self.label, "fatal", addr, repr(exc)),
                             label="subscription")
             raise SubscriptionFatal(
@@ -255,6 +268,7 @@ class SubscriptionWorker:
         st.consumer_until = max(st.consumer_until, until)
         if verdict.kind == "suspend-peer":
             st.peer_until = max(st.peer_until, until)
+        _SUSPENSIONS.inc()
         self.trace.append((now, "conn-end", addr, repr(exc)))
         sim.trace_event((self.label, "suspend", addr, verdict.kind,
                          round(until - now, 6), st.fail_count),
@@ -284,6 +298,10 @@ class SubscriptionWorker:
                     break
                 st = self.states[addr]
                 st.connected = True
+                if st.dials:
+                    _RECONNECTS.inc()
+                st.dials += 1
+                _DIALS.inc()
                 self.trace.append((sim.now(), "dial", addr))
                 sim.trace_event((self.label, "dial", addr, st.fail_count),
                                 label="subscription")

@@ -29,6 +29,17 @@ from dataclasses import dataclass
 from typing import Mapping, Optional
 
 from .. import simharness as sim
+from ..observe import metrics as _metrics
+
+# one firing counter for all watchdogs; per-protocol attribution stays
+# in the typed WatchdogTimeout / sim trace (names are few and static, so
+# a per-protocol counter is also kept, created at first firing)
+_FIRINGS = _metrics.counter("watchdog.firings")
+
+
+def _count_firing(protocol: str) -> None:
+    _FIRINGS.inc()
+    _metrics.counter(f"watchdog.firings.{protocol}").inc()
 
 
 class WatchdogTimeout(Exception):
@@ -110,6 +121,7 @@ async def recv_with_limit(session, limits: ProtocolTimeLimits,
     if limit is not None:
         ready = await session.channel.wait_ready(limit)
         if not ready:
+            _count_firing(limits.name)
             sim.trace_event(("timeout", limits.name, session.state,
                              peer_id), label="watchdog")
             raise WatchdogTimeout(limits.name, session.state, limit)
@@ -128,6 +140,7 @@ async def collect_with_limit(session, limits: ProtocolTimeLimits,
     if limit is not None:
         ready = await session.channel.wait_ready(limit)
         if not ready:
+            _count_firing(limits.name)
             sim.trace_event(("timeout", limits.name, state, peer_id),
                             label="watchdog")
             raise WatchdogTimeout(limits.name, state, limit)

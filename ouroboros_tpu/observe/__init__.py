@@ -1,0 +1,56 @@
+"""ouroboros_tpu.observe — the unified observability layer.
+
+Three parts, one seam (ISSUE 7):
+
+- `metrics`: a process-wide registry of named counters/gauges/histograms
+  with deterministic sorted snapshots.  The precompute cache stats, the
+  autotuner's decision/frozen-write counters, subscription reconnects,
+  watchdog firings and mux teardowns all live here.
+- `spans`: hierarchical timing spans with explicit block_until_ready
+  fencing, splitting every replay window into host-seq / dispatch /
+  device / compile / sync phases.  Monotonic-clock only, sim-time aware
+  (the same API yields virtual durations under simharness).
+- `export`: Prometheus text exposition, chrome://tracing span dumps,
+  and the typed-tracer-events -> JSONL bridge.
+- `adapter`: NodeTracers -> metrics (typed protocol events count without
+  string matching).
+
+Defaults: metric writes are ON (an enabled counter bump is one flag
+read plus an int add) and span recording is OFF (spans allocate and
+read clocks; the bench/tests enable them around regions they study).
+Both layers are near-free when off — `spans.span()` returns a shared
+null context manager, a gated metric write is a single flag read — and
+`enable()/disable()` flip them together.  The migrated precompute/
+autotune counters are `always=True`: they are load-bearing program
+state (bench and tests assert on them) that the registry exports, not
+observation that the flag may drop.
+"""
+from __future__ import annotations
+
+from . import adapter, export, metrics, spans
+from .adapter import counting_node_tracers, metrics_node_tracers
+from .metrics import REGISTRY, Counter, Gauge, Histogram, MetricsRegistry
+from .spans import RECORDER, Span, SpanRecorder, phase_totals, span
+
+__all__ = [
+    "REGISTRY", "RECORDER", "Counter", "Gauge", "Histogram",
+    "MetricsRegistry", "Span", "SpanRecorder",
+    "adapter", "counting_node_tracers", "disable", "enable", "enabled",
+    "export", "metrics", "metrics_node_tracers", "phase_totals", "span",
+    "spans",
+]
+
+
+def enable() -> None:
+    """Turn on metrics writes and span recording."""
+    metrics.REGISTRY.enable()
+    spans.RECORDER.enable()
+
+
+def disable() -> None:
+    metrics.REGISTRY.disable()
+    spans.RECORDER.disable()
+
+
+def enabled() -> bool:
+    return metrics.REGISTRY.enabled or spans.RECORDER.enabled

@@ -1,0 +1,260 @@
+"""Process-wide metrics registry — named counters/gauges/histograms with
+deterministic snapshots.
+
+The reference threads a contravariant `Tracer m a` through every
+constructor but ships no metrics layer; our reproduction had outgrown
+its ad-hoc equivalents (private counters in crypto/precompute.py and
+crypto/autotune.py, one-off breakdowns printed by bench.py).  This
+module is the one seam they all migrate into.
+
+Design constraints, in order:
+
+1. **Near-free when disabled.**  Every observational write goes through
+   one flag read (`registry.enabled`); a disabled registry performs NO
+   instrument writes at all — asserted by the bench --smoke probe via
+   `data_writes`, which counts gated writes that actually landed.
+2. **Deterministic snapshots.**  `snapshot()` returns instruments in
+   sorted name order with values that are pure functions of the workload
+   at a fixed seed (counts, not wall times), so two bench runs emit
+   byte-identical `metrics` sections and the output stays diffable.
+   Instruments that hold measured durations or other run-varying values
+   are created with `stable=False` and excluded from `snapshot()`
+   (they still appear in the Prometheus exposition, which is allowed to
+   vary run to run).
+3. **Functional counters stay functional.**  The migrated precompute /
+   autotune counters are *load-bearing* — tests and bench assertions
+   gate on them (warm windows do zero fills; frozen tuners reject
+   writes).  Those are created with `always=True`: they count whether or
+   not observation is enabled, and their writes are not charged to
+   `data_writes` (they are program state that happens to be exported,
+   not observation).
+
+Instruments can exist unregistered (``Counter("x")``): per-instance
+caches in tests get private counters with the same API while only the
+process-wide singletons bind into the global registry — two fresh
+`PrecomputeCache` instances never fight over one name.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+class Counter:
+    """Monotonic-by-convention numeric cell.  `value` is read/write so
+    migrated call sites using `cache.hits += 1` keep working through a
+    property alias."""
+
+    kind = "counter"
+    __slots__ = ("name", "value", "always", "stable", "_reg")
+
+    def __init__(self, name: str, reg: Optional["MetricsRegistry"] = None,
+                 always: bool = False, stable: bool = True):
+        self.name = name
+        self.value = 0
+        self.always = always
+        self.stable = stable
+        self._reg = reg
+
+    def inc(self, n: int = 1) -> None:
+        if self.always:
+            self.value += n
+            return
+        reg = self._reg
+        if reg is not None and reg.enabled:
+            self.value += n
+            reg.data_writes += 1
+
+    def snapshot_value(self):
+        return self.value
+
+
+class Gauge:
+    """Last-write-wins numeric cell."""
+
+    kind = "gauge"
+    __slots__ = ("name", "value", "always", "stable", "_reg")
+
+    def __init__(self, name: str, reg: Optional["MetricsRegistry"] = None,
+                 always: bool = False, stable: bool = True):
+        self.name = name
+        self.value = 0
+        self.always = always
+        self.stable = stable
+        self._reg = reg
+
+    def set(self, v) -> None:
+        if self.always:
+            self.value = v
+            return
+        reg = self._reg
+        if reg is not None and reg.enabled:
+            self.value = v
+            reg.data_writes += 1
+
+    def snapshot_value(self):
+        return self.value
+
+
+# default buckets suit the quantities this repo observes (queue depths,
+# batch sizes, retry counts) — powers of two up to a replay window
+DEFAULT_BUCKETS: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256,
+                                      512, 1024, 2048, 4096)
+
+
+class Histogram:
+    """Fixed-bucket histogram (cumulative counts on export, per the
+    Prometheus convention; stored per-bucket so observe() is one index
+    update)."""
+
+    kind = "histogram"
+    __slots__ = ("name", "buckets", "counts", "total", "count", "always",
+                 "stable", "_reg")
+
+    def __init__(self, name: str, reg: Optional["MetricsRegistry"] = None,
+                 buckets: Iterable[float] = DEFAULT_BUCKETS,
+                 always: bool = False, stable: bool = True):
+        self.name = name
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * (len(self.buckets) + 1)   # +inf overflow
+        self.total = 0.0
+        self.count = 0
+        self.always = always
+        self.stable = stable
+        self._reg = reg
+
+    def _record(self, v) -> None:
+        i = 0
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                break
+        else:
+            i = len(self.buckets)
+        self.counts[i] += 1
+        self.total += v
+        self.count += 1
+
+    def observe(self, v) -> None:
+        if self.always:
+            self._record(v)
+            return
+        reg = self._reg
+        if reg is not None and reg.enabled:
+            self._record(v)
+            reg.data_writes += 1
+
+    def snapshot_value(self):
+        # integers only (total may be float when observing floats; round
+        # to a fixed precision so the snapshot stays byte-stable)
+        return {"count": self.count,
+                "sum": round(self.total, 9),
+                "buckets": {repr(b): c for b, c in
+                            zip(self.buckets, self.counts[:-1])},
+                "overflow": self.counts[-1]}
+
+
+class MetricsRegistry:
+    """Name -> instrument map with idempotent creation and deterministic
+    snapshots.  One process-wide instance lives at `observe.metrics
+    .REGISTRY`; tests build private ones."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.data_writes = 0          # gated writes that landed (probe)
+        self._instruments: Dict[str, object] = {}
+
+    # -- creation (idempotent by name) ----------------------------------
+    def _make(self, cls, name: str, **kw):
+        inst = self._instruments.get(name)
+        if inst is not None:
+            if not isinstance(inst, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(inst).__name__}, not {cls.__name__}")
+            return inst
+        inst = cls(name, reg=self, **kw)
+        self._instruments[name] = inst
+        return inst
+
+    def counter(self, name: str, always: bool = False,
+                stable: bool = True) -> Counter:
+        return self._make(Counter, name, always=always, stable=stable)
+
+    def gauge(self, name: str, always: bool = False,
+              stable: bool = True) -> Gauge:
+        return self._make(Gauge, name, always=always, stable=stable)
+
+    def histogram(self, name: str, buckets: Iterable[float] = DEFAULT_BUCKETS,
+                  always: bool = False, stable: bool = True) -> Histogram:
+        return self._make(Histogram, name, buckets=buckets, always=always,
+                          stable=stable)
+
+    def get(self, name: str):
+        return self._instruments.get(name)
+
+    # -- enable/disable --------------------------------------------------
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    # -- snapshots --------------------------------------------------------
+    def instruments(self) -> List[object]:
+        return [self._instruments[k] for k in sorted(self._instruments)]
+
+    def snapshot(self, include_unstable: bool = False) -> dict:
+        """{name: value} in sorted name order.  Only `stable` instruments
+        by default — the deterministic, diffable subset (bench emits this
+        verbatim into its JSON).  Histograms render as nested dicts with
+        repr'd bucket edges."""
+        out = {}
+        for name in sorted(self._instruments):
+            inst = self._instruments[name]
+            if inst.stable or include_unstable:
+                out[name] = inst.snapshot_value()
+        return out
+
+    def snapshot_json(self, include_unstable: bool = False) -> str:
+        """Canonical byte form of snapshot() (sorted keys, no spaces) —
+        the thing two same-seed runs must agree on byte for byte."""
+        return json.dumps(self.snapshot(include_unstable),
+                          sort_keys=True, separators=(",", ":"))
+
+    def reset(self) -> None:
+        """Zero every instrument (tests); registration survives."""
+        for inst in self._instruments.values():
+            if isinstance(inst, Histogram):
+                inst.counts = [0] * (len(inst.buckets) + 1)
+                inst.total = 0.0
+                inst.count = 0
+            else:
+                inst.value = 0
+        self.data_writes = 0
+
+
+# the process-wide registry: crypto caches, the autotuner, network
+# counters and the span layer all bind into this one
+REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return REGISTRY
+
+
+def counter(name: str, always: bool = False, stable: bool = True) -> Counter:
+    return REGISTRY.counter(name, always=always, stable=stable)
+
+
+def gauge(name: str, always: bool = False, stable: bool = True) -> Gauge:
+    return REGISTRY.gauge(name, always=always, stable=stable)
+
+
+def histogram(name: str, buckets: Iterable[float] = DEFAULT_BUCKETS,
+              always: bool = False, stable: bool = True) -> Histogram:
+    return REGISTRY.histogram(name, buckets=buckets, always=always,
+                              stable=stable)
+
+
+def enabled() -> bool:
+    return REGISTRY.enabled

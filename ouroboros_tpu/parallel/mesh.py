@@ -11,6 +11,8 @@ from typing import Optional, Sequence
 import jax
 from jax.sharding import Mesh
 
+from ..observe import metrics as _metrics
+from ..observe import spans as _spans
 
 WINDOW_AXIS = "window"   # the header-window (proof-batch) axis
 
@@ -43,15 +45,27 @@ def enable_compile_cache(cache_dir: Optional[str] = None) -> str:
 def log_compile_time(what: str, stream=None):
     """Wall-time a compile-heavy block and print one log line, so a
     multi-minute XLA compile shows up in the harness tail instead of
-    looking like a hang until the timeout kills it."""
+    looking like a hang until the timeout kills it.
+
+    Also records a `compile` span and yields a result dict whose
+    ``secs`` field carries the elapsed seconds after the block exits —
+    callers that must REPORT compile cost (the multichip dryrun JSON)
+    bind it: ``with log_compile_time(...) as ct: ...; ct["secs"]``."""
     stream = stream if stream is not None else sys.stderr
+    out = {"what": what, "secs": None}
     t0 = time.perf_counter()
     print(f"[parallel] {what}: compiling...", file=stream, flush=True)
+    span_cm = _spans.span(f"compile.{what}", cat="compile")
+    span_cm.__enter__()
     try:
-        yield
+        yield out
     finally:
-        print(f"[parallel] {what}: done in "
-              f"{time.perf_counter() - t0:.1f}s", file=stream, flush=True)
+        span_cm.__exit__(None, None, None)
+        out["secs"] = round(time.perf_counter() - t0, 3)
+        _metrics.gauge("parallel.last_compile_secs",
+                       stable=False).set(out["secs"])
+        print(f"[parallel] {what}: done in {out['secs']:.1f}s",
+              file=stream, flush=True)
 
 
 def make_mesh(n_devices: Optional[int] = None,

@@ -254,13 +254,40 @@ class ShardedJaxBackend(CryptoBackend):
 
         fn = jax.jit(call, donate_argnums=(0, 1, 2)) if self._donate \
             else jax.jit(call)
+        from ..crypto.jax_backend import _compile_span_on_first_call
+        fn = _compile_span_on_first_call(
+            fn, f"sharded.composite({ne},{nv},{nb})"
+                f"@mesh{len(self.mesh.devices.flat)}")
         self._composites[key] = fn
         return fn
+
+    def prewarm_window(self, reqs, next_beta_proofs=()):
+        """Run one full window for `reqs` NOW — compiling its sharded
+        composite outside any timed/timeout-budgeted region — returning
+        ``(wall_seconds, ok_vector)``: the seconds (dominated by XLA
+        compile on a cold cache) plus the window's verdicts, so callers
+        assert correctness on THIS run instead of paying a duplicate
+        window for it.  MULTICHIP_r05 follow-up: a silent 4m25s compile
+        inside the timed region turned into rc=124 with zero
+        attribution; the dryrun now pre-warms and reports this number
+        instead."""
+        import time as _time
+        from ..observe import spans as _ospans
+        t0 = _time.perf_counter()
+        with _ospans.span("sharded.prewarm", cat="compile"):
+            ok, _ = self.finish_window(
+                self.submit_window(reqs, next_beta_proofs))
+        return _time.perf_counter() - t0, ok
 
     def submit_window(self, reqs, next_beta_proofs=()):
         """Mesh-sharded analog of JaxBackend.submit_window: same host
         prep, same packed result layout, batches sharded over the window
         axis.  Returns the opaque state finish_window consumes."""
+        from ..observe import spans as _ospans
+        with _ospans.span("window.submit", cat="dispatch"):
+            return self._submit_window(reqs, next_beta_proofs)
+
+    def _submit_window(self, reqs, next_beta_proofs=()):
         from ..crypto import vrf_jax
         # KES hash paths reduce on host here, but through the cross-
         # window outcome cache: a pool's per-period Merkle walk is

@@ -1,0 +1,426 @@
+"""ouroboros_tpu/observe test surface (ISSUE 7 satellite):
+
+- registry determinism: snapshots sorted by name and byte-identical for
+  identical workloads regardless of instrument creation order;
+- span nesting + fencing under both the wall clock and the sim virtual
+  clock (exact virtual durations — the same API works under simharness);
+- golden files for the three exporters (Prometheus text exposition,
+  chrome://tracing trace_event JSON, typed-events JSONL) built from
+  hand-constructed fixtures with pinned timestamps, so the golden bytes
+  are fully deterministic.  Regenerate after an INTENTIONAL format
+  change with:  OURO_REGEN_GOLDEN=1 pytest tests/test_observe.py
+- the zero-overhead probe: with observation disabled, gated instruments
+  perform no writes at all, `span()` returns one shared null context
+  manager, and `always` (load-bearing) counters keep counting without
+  charging `data_writes`.
+"""
+import io
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ouroboros_tpu import simharness as sim
+from ouroboros_tpu.observe import adapter, export, metrics, spans
+from ouroboros_tpu.observe.metrics import MetricsRegistry
+from ouroboros_tpu.observe.spans import Span, SpanRecorder
+from ouroboros_tpu.utils.tracer import (
+    TraceAddBlock, TraceChainSyncEvent, TraceFetchDecision,
+    TraceForgeEvent, collecting,
+)
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "golden", "observe")
+
+
+# ---------------------------------------------------------------------------
+# registry determinism
+# ---------------------------------------------------------------------------
+
+def _workload(reg: MetricsRegistry, order: int = 0):
+    """The same instrument writes, issued under two creation orders."""
+    names = ["b.window", "a.hits", "c.depth"]
+    if order:
+        names.reverse()
+    for n in names:
+        if n == "c.depth":
+            reg.gauge(n)
+        else:
+            reg.counter(n)
+    reg.counter("a.hits").inc(3)
+    reg.counter("b.window").inc()
+    reg.gauge("c.depth").set(7)
+    h = reg.histogram("d.sizes", buckets=(1, 2, 4))
+    for v in (1, 2, 3, 9):
+        h.observe(v)
+
+
+def test_snapshot_sorted_and_byte_identical_across_creation_order():
+    r1, r2 = MetricsRegistry(), MetricsRegistry()
+    _workload(r1, order=0)
+    _workload(r2, order=1)
+    snap = r1.snapshot()
+    assert list(snap) == sorted(snap)
+    assert r1.snapshot_json() == r2.snapshot_json()
+    # and across repeated renders of the same registry
+    assert r1.snapshot_json() == r1.snapshot_json()
+
+
+def test_snapshot_values_and_histogram_shape():
+    reg = MetricsRegistry()
+    _workload(reg)
+    snap = reg.snapshot()
+    assert snap["a.hits"] == 3
+    assert snap["c.depth"] == 7
+    assert snap["d.sizes"]["count"] == 4
+    assert snap["d.sizes"]["sum"] == 15
+    assert snap["d.sizes"]["buckets"] == {"1": 1, "2": 1, "4": 1}
+    assert snap["d.sizes"]["overflow"] == 1
+
+
+def test_unstable_instruments_excluded_from_snapshot_not_prometheus():
+    reg = MetricsRegistry()
+    reg.counter("stable.count").inc()
+    reg.gauge("measured.secs", stable=False).set(1.234)
+    snap = reg.snapshot()
+    assert "stable.count" in snap and "measured.secs" not in snap
+    assert "measured.secs" in reg.snapshot(include_unstable=True)
+    prom = export.prometheus_text(reg)
+    assert "ouro_measured_secs" in prom and "ouro_stable_count" in prom
+
+
+def test_instrument_creation_idempotent_and_kind_checked():
+    reg = MetricsRegistry()
+    c = reg.counter("x")
+    assert reg.counter("x") is c
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+def test_reset_zeroes_values_but_keeps_registration():
+    reg = MetricsRegistry()
+    _workload(reg)
+    writes = reg.data_writes
+    assert writes > 0
+    reg.reset()
+    assert reg.data_writes == 0
+    assert reg.counter("a.hits").value == 0
+    assert reg.histogram("d.sizes", buckets=(1, 2, 4)).count == 0
+    assert set(reg.snapshot()) == {"a.hits", "b.window", "c.depth",
+                                   "d.sizes"}
+
+
+# ---------------------------------------------------------------------------
+# the zero-overhead probe (disabled observation)
+# ---------------------------------------------------------------------------
+
+def test_disabled_registry_performs_zero_writes():
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("c")
+    g = reg.gauge("g")
+    h = reg.histogram("h")
+    c.inc(5)
+    g.set(9)
+    h.observe(3)
+    assert c.value == 0 and g.value == 0 and h.count == 0
+    assert reg.data_writes == 0
+
+
+def test_always_counters_count_when_disabled_without_data_writes():
+    """Migrated load-bearing counters (precompute fills, frozen-tuner
+    writes) are program state: they count regardless of the flag and
+    are never charged to the disabled-observation probe."""
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("precompute.like", always=True)
+    c.inc(2)
+    assert c.value == 2
+    assert reg.data_writes == 0
+
+
+def test_disabled_recorder_returns_one_shared_null_cm():
+    rec = SpanRecorder(enabled=False)
+    cm1 = rec.span("a", cat="device")
+    cm2 = rec.span("b", cat="compile", fence=True)
+    assert cm1 is cm2                      # no per-call allocation
+    with cm1:
+        pass
+    assert rec.roots == [] and rec._stack == []
+
+
+def test_global_enable_disable_flip_both_layers():
+    from ouroboros_tpu import observe
+    was_reg, was_rec = metrics.REGISTRY.enabled, spans.RECORDER.enabled
+    try:
+        observe.disable()
+        assert not metrics.REGISTRY.enabled
+        assert not spans.RECORDER.enabled
+        assert not observe.enabled()
+        observe.enable()
+        assert observe.enabled()
+    finally:
+        metrics.REGISTRY.enabled, spans.RECORDER.enabled = was_reg, was_rec
+
+
+# ---------------------------------------------------------------------------
+# span nesting + fencing, wall clock and sim clock
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_wall_clock():
+    rec = SpanRecorder(enabled=True)
+    with rec.span("outer", cat="dispatch"):
+        with rec.span("inner", cat="device"):
+            pass
+    roots = rec.drain()
+    assert len(roots) == 1
+    outer = roots[0]
+    assert outer.name == "outer" and outer.cat == "dispatch"
+    (inner,) = outer.children
+    assert inner.name == "inner" and inner.cat == "device"
+    assert outer.t0 <= inner.t0 <= inner.t1 <= outer.t1
+    assert rec.drain() == []               # drain is consuming
+
+
+def test_span_sim_clock_exact_virtual_durations():
+    """Under an active Sim runtime the span clock is virtual time, so
+    durations are EXACT — the sim-time-aware half of the spans API."""
+    rec = SpanRecorder(enabled=True)
+
+    async def main():
+        with rec.span("rep", cat="host-seq"):
+            await sim.sleep(2.5)
+            with rec.span("drain", cat="device"):
+                await sim.sleep(1.25)
+
+    sim.run(main())
+    (rep,) = rec.drain()
+    assert rep.duration == 3.75
+    (drain,) = rep.children
+    assert drain.duration == 1.25
+    assert spans.phase_totals([rep]) == {"host-seq": 2.5, "device": 1.25}
+
+
+def test_fenced_span_fences_both_edges(monkeypatch):
+    fences = []
+    monkeypatch.setattr(spans, "device_fence",
+                        lambda: fences.append(len(fences)))
+    rec = SpanRecorder(enabled=True)
+    with rec.span("r", cat="sync", fence=True):
+        assert fences == [0]               # entry edge fenced
+    assert len(fences) == 2                # exit edge fenced too
+    with rec.span("n", cat="sync"):        # fence=False: no fence calls
+        pass
+    assert len(fences) == 2
+
+
+def test_device_fence_never_imports_jax(monkeypatch):
+    monkeypatch.delitem(sys.modules, "jax", raising=False)
+    spans.device_fence()                   # must be a pure no-op
+    assert "jax" not in sys.modules
+
+
+def test_phase_totals_attributes_self_time_once():
+    outer = Span("submit", "dispatch", 0.0)
+    outer.t1 = 10.0
+    inner = Span("composite", "compile", 2.0)
+    inner.t1 = 7.0
+    outer.children.append(inner)
+    totals = spans.phase_totals([outer])
+    assert totals == {"dispatch": 5.0, "compile": 5.0}
+    assert sum(totals.values()) == outer.duration   # nothing counted twice
+
+
+def test_out_of_order_close_reparents_and_closes_survivors():
+    """A generator-held span closed late must not corrupt the stack:
+    the still-open inner span is adopted and closed at the same stamp."""
+    rec = SpanRecorder(enabled=True)
+    a = rec._open("a", "host-seq")
+    b = rec._open("b", "device")
+    rec._close(a)                          # closes a while b still open
+    (root,) = rec.drain()
+    assert root is a
+    assert [c.name for c in a.children] == ["b"]
+    assert b.t1 == a.t1
+    assert rec._stack == []
+
+
+def test_adopted_span_late_close_is_not_recorded_twice():
+    """The survivor's OWN context-manager exit still fires after it was
+    adopted by the out-of-order close; that second _close must be a
+    no-op — re-recording it would add it as a second root (duplicated
+    in the chrome trace) and overwrite its t1 past its parent's."""
+    rec = SpanRecorder(enabled=True)
+    a = rec._open("a", "host-seq")
+    b = rec._open("b", "device")
+    rec._close(a)                          # adopts + stamps b
+    stamped = b.t1
+    rec._close(b)                          # b's CM exits late
+    (root,) = rec.drain()                  # a only — b is not a root
+    assert root is a and a.children == [b]
+    assert b.t1 == stamped                 # stamp not overwritten
+    assert rec.drain() == []
+
+
+def test_root_overflow_drops_and_counts():
+    rec = SpanRecorder(enabled=True, max_roots=2)
+    for i in range(4):
+        with rec.span(f"s{i}"):
+            pass
+    assert len(rec.roots) == 2
+    assert rec.dropped == 2
+
+
+# ---------------------------------------------------------------------------
+# exporter golden files
+# ---------------------------------------------------------------------------
+
+def _golden_registry() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    reg.counter("precompute.hits", always=True).inc(5)
+    reg.counter("window.count").inc(3)
+    reg.gauge("queue.depth").set(4)
+    reg.gauge("autotune.last_secs", stable=False).set(0.125)
+    h = reg.histogram("batch.size", buckets=(1, 2, 4))
+    for v in (1, 1, 3, 9):
+        h.observe(v)
+    return reg
+
+
+def _golden_spans():
+    rep = Span("rep", "host-seq", 0.0)
+    rep.t1 = 10.0
+    sub = Span("window.submit", "dispatch", 1.0)
+    sub.t1 = 3.0
+    comp = Span("window.composite(8,8,2,0)", "compile", 1.5)
+    comp.t1 = 2.5
+    comp.meta = {"ne": 8}
+    drain = Span("window.drain", "device", 3.0)
+    drain.t1 = 6.0
+    sub.children.append(comp)
+    rep.children.extend([sub, drain])
+    return [rep]
+
+
+def _golden_events():
+    return [
+        TraceChainSyncEvent(peer_id="p1", event="roll-forward", slot=3,
+                            n=4),
+        TraceForgeEvent(slot=9, outcome="forged"),
+        TraceAddBlock(kind="extended", slot=1, block_no=1,
+                      hash=b"\x01\x02"),
+        ("raw", 7),                        # non-dataclass payload
+    ]
+
+
+def _check_golden(name: str, text: str):
+    path = os.path.join(GOLDEN_DIR, name)
+    if os.environ.get("OURO_REGEN_GOLDEN"):
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(text)
+    with open(path) as f:
+        golden = f.read()
+    assert text == golden, (
+        f"{name} drifted from its golden bytes; if the format change is "
+        f"intentional: OURO_REGEN_GOLDEN=1 pytest tests/test_observe.py")
+
+
+def test_prometheus_exposition_golden_and_roundtrip():
+    text = export.prometheus_text(_golden_registry())
+    _check_golden("metrics.prom", text)
+    parsed = export.parse_prometheus_text(text)
+    assert parsed["ouro_precompute_hits"] == 5.0
+    assert parsed["ouro_window_count"] == 3.0
+    assert parsed["ouro_autotune_last_secs"] == 0.125
+    assert parsed['ouro_batch_size_bucket{le="+Inf"}'] == 4.0
+    assert parsed["ouro_batch_size_sum"] == 14.0
+    assert parsed["ouro_batch_size_count"] == 4.0
+    # cumulative bucket counts, per the Prometheus convention
+    assert parsed['ouro_batch_size_bucket{le="1"}'] == 2.0
+    assert parsed['ouro_batch_size_bucket{le="4"}'] == 3.0
+
+
+def test_chrome_trace_golden_and_structure():
+    doc = export.chrome_trace(_golden_spans())
+    _check_golden("spans.trace.json",
+                  json.dumps(doc, sort_keys=True) + "\n")
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in events}
+    assert names == {"rep", "window.submit", "window.composite(8,8,2,0)",
+                     "window.drain"}
+    # one tid row per category so phases render as parallel tracks
+    by_cat = {e["cat"]: e["tid"] for e in events}
+    assert len(set(by_cat.values())) == len(by_cat)
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in meta} == set(by_cat)
+    comp = next(e for e in events
+                if e["name"] == "window.composite(8,8,2,0)")
+    assert comp["ts"] == 1.5e6 and comp["dur"] == 1e6
+    assert comp["args"] == {"ne": 8}
+
+
+def test_events_jsonl_golden_and_typed_schema():
+    text = export.events_jsonl(_golden_events())
+    _check_golden("events.jsonl", text)
+    lines = [json.loads(ln) for ln in text.splitlines()]
+    assert [ln["type"] for ln in lines] == [
+        "TraceChainSyncEvent", "TraceForgeEvent", "TraceAddBlock",
+        "tuple"]
+    assert lines[0]["event"] == "roll-forward"   # field kept alongside
+    assert lines[0]["n"] == 4
+    assert lines[2]["hash"] == "0102"      # bytes hex-encoded
+    assert lines[3]["payload"] == ["raw", 7]
+
+
+def test_jsonl_tracer_is_a_live_bridge():
+    fh = io.StringIO()
+    tr = export.jsonl_tracer(fh)
+    assert tr.active
+    tr.trace(TraceForgeEvent(slot=1, outcome="not-leader"))
+    tr.trace(TraceForgeEvent(slot=2, outcome="forged"))
+    lines = [json.loads(ln) for ln in fh.getvalue().splitlines()]
+    assert [(ln["slot"], ln["outcome"]) for ln in lines] == [
+        (1, "not-leader"), (2, "forged")]
+
+
+# ---------------------------------------------------------------------------
+# NodeTracers -> metrics adapter
+# ---------------------------------------------------------------------------
+
+def test_adapter_counts_by_event_class_not_string():
+    reg = MetricsRegistry()
+    nt = adapter.metrics_node_tracers(reg)
+    nt.chain_sync.trace(TraceChainSyncEvent("p", "roll-forward", 1, n=3))
+    nt.chain_sync.trace(TraceChainSyncEvent("p", "validated", 2))
+    nt.forge.trace(TraceForgeEvent(5, "forged"))
+    snap = reg.snapshot()
+    assert snap["node.chainsync.TraceChainSyncEvent"] == 4   # n-weighted
+    assert snap["node.forge.TraceForgeEvent"] == 1
+    assert "node.fetch.TraceFetchDecision" not in snap
+
+
+def test_adapter_counting_tee_forwards_and_counts():
+    reg = MetricsRegistry()
+    inner, evs = collecting()
+    t = adapter.counting("fetch", inner, reg)
+    ev = TraceFetchDecision("p", 2, 0, "request")
+    t.trace(ev)
+    assert evs == [ev]                     # event still reaches its sink
+    assert reg.snapshot()["node.fetch.TraceFetchDecision"] == 1
+
+
+def test_precompute_counters_live_in_global_registry():
+    """The migrated cache counters are registry instruments AND the old
+    attribute names — one source of truth, aliases kept (satellite)."""
+    from ouroboros_tpu.crypto.precompute import GLOBAL_PRECOMPUTE_CACHE
+    inst = metrics.REGISTRY.get("precompute.hits")
+    assert inst is not None
+    assert inst is GLOBAL_PRECOMPUTE_CACHE._counters["hits"]
+    before = GLOBAL_PRECOMPUTE_CACHE.hits
+    GLOBAL_PRECOMPUTE_CACHE.hits += 1      # writeable alias
+    try:
+        assert inst.value == before + 1
+    finally:
+        GLOBAL_PRECOMPUTE_CACHE.hits = before

@@ -18,6 +18,7 @@ import pytest
 from tools.analysis import Baseline, Finding, run_passes
 from tools.analysis.conc_pass import lint_source as conc_lint
 from tools.analysis.jax_pass import lint_source as jax_lint
+from tools.analysis.obs_pass import lint_source as obs_lint
 from tools.analysis.protocol_pass import (
     check_spec, discover, message_inventory,
 )
@@ -546,6 +547,59 @@ def test_conc005_nested_atomically_fires_or_else_allowed():
         "    await sim.atomically(tx_fn)\n", "fx.py") == []
 
 
+# --- (b) obs-pass fixtures ---------------------------------------------------
+
+def test_obs001_unguarded_dataclass_build_fires():
+    f = obs_lint(
+        "def submit(tracer, ne, nv):\n"
+        "    tracer.trace(WindowDispatched(ne, nv))\n", "fx.py")
+    assert _rules(f) == {"OBS001"}
+    assert f[0].symbol == "submit"
+
+
+def test_obs001_unguarded_fstring_fires_including_trace_event():
+    f = obs_lint(
+        "def submit(key):\n"
+        "    sim.trace_event(f'window {key}', label='crypto')\n", "fx.py")
+    assert _rules(f) == {"OBS001"}
+    f = obs_lint(
+        "def submit(tracer, key):\n"
+        "    tracer.trace('shape %s' % (key,))\n", "fx.py")
+    assert _rules(f) == {"OBS001"}
+
+
+def test_obs001_active_guard_clears_it():
+    assert obs_lint(
+        "def submit(tracer, ne, nv):\n"
+        "    if tracer.active:\n"
+        "        tracer.trace(WindowDispatched(ne, nv))\n", "fx.py") == []
+    # guard on a tracer held in an attribute chain counts too
+    assert obs_lint(
+        "def submit(self, ne):\n"
+        "    if self.tracers.fetch.active:\n"
+        "        self.tracers.fetch.trace(Ev(ne))\n", "fx.py") == []
+
+
+def test_obs001_cheap_payloads_allowed():
+    """Constants, names and plain tuple builds are as cheap as the
+    guard itself — no finding."""
+    assert obs_lint(
+        "def submit(tracer, ne, nv):\n"
+        "    tracer.trace((ne, nv, 'window'))\n"
+        "    tracer.trace(EVENT_CONSTANT)\n", "fx.py") == []
+
+
+def test_obs_pass_live_tree_clean_modulo_baseline():
+    """Acceptance (ISSUE 7): the only tolerated unguarded construction
+    sites on the crypto/parallel hot paths carry justifications."""
+    report = run_passes(["obs"], Baseline.load())
+    assert report.new == [], "\n".join(f.render() for f in report.new)
+    assert report.stale == [], report.stale
+    for e in Baseline.load().entries.get("obs", []):
+        assert e["justification"].strip() and "TODO" not in \
+            e["justification"], e
+
+
 # --- baseline canonical form -------------------------------------------------
 
 def test_baseline_load_dump_round_trips_byte_identically(tmp_path):
@@ -576,7 +630,8 @@ def test_cli_format_json_schema_and_exit_code():
     doc = json.loads(r.stdout)
     assert doc["tool"] == "ouro-lint" and doc["schema_version"] == 1
     assert doc["blocking"] is False and doc["new"] == []
-    assert set(doc["summary"]) == {"conc", "jax", "protocol", "sim"}
+    assert set(doc["summary"]) == {"conc", "jax", "obs", "protocol",
+                                   "sim"}
     assert doc["baselined"], "committed baseline findings must surface"
     for f in doc["baselined"]:
         assert set(f) == {"file", "line", "rule", "symbol", "message"}

@@ -135,6 +135,43 @@ def test_bench_cli_flags_exist():
     assert "--smoke" in r.stdout and "--retune" in r.stdout
 
 
+def test_obsreport_cli(tmp_path):
+    """`python -m tools.obsreport` renders a bench JSON (raw or
+    harness-wrapped) as the phase/variance/cache summary table, and
+    reports pre-observability rounds' sections as absent."""
+    doc = {
+        "metric": "shelley_replay_proofs_per_sec", "value": 1000.0,
+        "unit": "proofs/s", "vs_baseline": 10.0, "reps": 2,
+        "spread": 0.1,
+        "variance": {
+            "per_phase": {
+                "device": {"median": 2.0, "min": 1.5, "max": 2.5,
+                           "spread_secs": 1.0, "spread_rel": 0.5},
+                "host-seq": {"median": 1.0, "min": 0.9, "max": 1.1,
+                             "spread_secs": 0.2, "spread_rel": 0.2}},
+            "dominant_phase": "device", "dominant_spread_secs": 1.0},
+        "precompute": {"hits": 5, "misses": 1},
+        "metrics": {"precompute.hits": 5,
+                    "d.sizes": {"count": 2, "sum": 3}},
+    }
+    raw = tmp_path / "bench.json"
+    raw.write_text(json.dumps(doc))
+    wrapped = tmp_path / "BENCH_rXX.json"
+    wrapped.write_text(json.dumps({"n": 1, "rc": 0, "parsed": doc}))
+    for p in (raw, wrapped):
+        r = _run("-m", "tools.obsreport", str(p))
+        assert r.returncode == 0, r.stderr
+        assert "largest cross-rep spread: 'device'" in r.stdout
+        assert "*device" in r.stdout and "precompute.hits" in r.stdout
+    # historic rounds (no phases/variance/metrics) still render
+    r = _run("-m", "tools.obsreport", "BENCH_r05.json")
+    assert r.returncode == 0, r.stderr
+    assert "no 'variance' section" in r.stdout
+    # non-bench input is a usage error, not a traceback
+    r = _run("-m", "tools.obsreport", "MULTICHIP_r05.json")
+    assert r.returncode == 2 and "cannot read" in r.stderr
+
+
 def test_shelley_replay_detects_tamper(shelley_db, tmp_path):
     import shutil
     bad = str(tmp_path / "badsh")

@@ -11,6 +11,9 @@ safety net as three registry/AST-driven passes:
   graphs under crypto/ and parallel/.
 - sim       (sim_pass.py): real-clock / real-IO / nondeterminism leaks in
   async code that runs on the deterministic Sim scheduler.
+- conc      (conc_pass.py): STM concurrency idioms that create races.
+- obs       (obs_pass.py): unguarded event construction at Tracer call
+  sites on the crypto/parallel hot paths.
 
 Findings are structured (file, line, rule, symbol, message).  A committed
 `baseline.json` suppresses known pre-existing findings by
@@ -71,7 +74,7 @@ def register(name: str):
 
 def _ensure_passes_loaded() -> None:
     from . import (  # noqa: F401
-        conc_pass, jax_pass, protocol_pass, sim_pass,
+        conc_pass, jax_pass, obs_pass, protocol_pass, sim_pass,
     )
 
 

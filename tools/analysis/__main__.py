@@ -1,6 +1,6 @@
 """ouro-lint CLI.
 
-    python -m tools.analysis [--strict] [--passes protocol,jax,sim,conc]
+    python -m tools.analysis [--strict] [--passes protocol,jax,sim,conc,obs]
                              [--baseline PATH | --no-baseline]
                              [--write-baseline]
                              [--format text|json|sarif]
@@ -38,7 +38,7 @@ def main(argv=None) -> int:
     ap.add_argument("--strict", action="store_true",
                     help="also fail (exit 1) on stale baseline entries")
     ap.add_argument("--passes", default=None,
-                    help="comma-separated subset of: protocol,jax,sim,conc")
+                    help="comma-separated subset of: protocol,jax,sim,conc,obs")
     ap.add_argument("--format", default="text",
                     choices=("text", "json", "sarif"),
                     help="output format (default text; json/sarif print "

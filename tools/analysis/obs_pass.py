@@ -1,0 +1,123 @@
+"""Pass 5 — observability hot-path lint (OBS001).
+
+A nop `Tracer` makes the trace() CALL free, but Python still evaluates
+the call's ARGUMENT first: a dataclass event build or an f-string
+formatted for a tracer that is not listening is pure hot-path waste —
+exactly the cost the contra-tracer design exists to avoid.  On the
+replay hot paths (crypto/, parallel/) every tracer call site whose
+payload does work must therefore sit under a `tracer.active` guard:
+
+    if tracer.active:
+        tracer.trace(WindowDispatched(ne, nv, f"{key}"))   # ok
+    tracer.trace(WindowDispatched(ne, nv))                 # OBS001
+    tracer.trace(EVENT_CONSTANT)                           # ok (cheap)
+
+- OBS001 unguarded-event-construction: `X.trace(arg)` / `X.trace(...)`
+  via an attribute chain ending in `.trace`, or a bare/dotted
+  `trace_event(...)` call, whose argument expression contains a Call,
+  an f-string (JoinedStr), a `%`/`+` on strings or a comprehension —
+  and no enclosing `if` whose test mentions `.active`.
+
+Cheap payloads (names, constants, attribute reads, plain tuples of
+those) pass: a tuple build of locals is two bytecode ops, the guard
+would cost as much as it saves.  Cold-path sites (an autotune
+measurement that runs once per shape per process) are tolerated via
+justified baseline entries, the same contract as every other pass.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from . import Finding, register, relpath
+from .astutil import QualnameVisitor, dotted_name, iter_py_files, parse_file
+
+SCAN_DIRS = ("ouroboros_tpu/crypto", "ouroboros_tpu/parallel")
+
+_TRACE_FN_NAMES = {"trace_event", "sim.trace_event"}
+
+
+def _is_trace_call(node: ast.Call) -> bool:
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "trace":
+        return True
+    name = dotted_name(node.func)
+    return name in _TRACE_FN_NAMES or (
+        name is not None and name.endswith(".trace_event"))
+
+
+def _expensive(node: ast.AST) -> bool:
+    """Does evaluating this argument expression do real work?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Call, ast.JoinedStr, ast.ListComp,
+                            ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            return True
+        if isinstance(sub, ast.BinOp):
+            # string build via % or + on anything non-constant-foldable
+            if isinstance(sub.op, (ast.Mod, ast.Add)) and not (
+                    isinstance(sub.left, ast.Constant)
+                    and isinstance(sub.right, ast.Constant)):
+                return True
+    return False
+
+
+def _guard_mentions_active(test: ast.AST) -> bool:
+    return any(isinstance(sub, ast.Attribute) and sub.attr == "active"
+               for sub in ast.walk(test))
+
+
+class _ObsLint(QualnameVisitor):
+    def __init__(self, file: str, findings: List[Finding]):
+        super().__init__()
+        self.file = file
+        self.findings = findings
+        self._guard_depth = 0
+
+    def visit_If(self, node: ast.If):
+        guarded = _guard_mentions_active(node.test)
+        self._guard_depth += guarded
+        for child in node.body:
+            self.visit(child)
+        self._guard_depth -= guarded
+        for child in node.orelse:
+            self.visit(child)
+
+    def visit_IfExp(self, node: ast.IfExp):
+        guarded = _guard_mentions_active(node.test)
+        self.visit(node.test)
+        self._guard_depth += guarded
+        self.visit(node.body)
+        self._guard_depth -= guarded
+        self.visit(node.orelse)
+
+    def visit_Call(self, node: ast.Call):
+        if _is_trace_call(node) and self._guard_depth == 0:
+            payload = list(node.args) + [kw.value for kw in node.keywords]
+            if any(_expensive(a) for a in payload):
+                self.findings.append(Finding(
+                    file=self.file, line=node.lineno, rule="OBS001",
+                    symbol=self.qualname,
+                    message="event constructed (call/f-string) for a "
+                            "tracer that may be nop; guard the call "
+                            "site with `if tracer.active:` on hot "
+                            "paths"))
+        self.generic_visit(node)
+
+
+def lint_source(source: str, file: str) -> List[Finding]:
+    """Run the OBS pass over one source text (fixture entry point)."""
+    findings: List[Finding] = []
+    _ObsLint(file, findings).visit(ast.parse(source, filename=file))
+    return sorted(set(findings))
+
+
+def run_files(paths: Iterable[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in paths:
+        lint = _ObsLint(relpath(path), findings)
+        lint.visit(parse_file(path))
+    return sorted(set(findings))
+
+
+@register("obs")
+def run() -> List[Finding]:
+    return run_files(iter_py_files(*SCAN_DIRS))

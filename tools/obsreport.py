@@ -1,0 +1,157 @@
+"""obsreport — human-readable summary of a bench round's observability
+sections.
+
+    python -m tools.obsreport BENCH_r05.json
+    python bench.py > out.json && python -m tools.obsreport out.json
+
+Accepts either a raw bench JSON object (what `python bench.py` prints)
+or a harness record wrapping one under ``parsed`` (the committed
+BENCH_r*.json files).  Prints, in order:
+
+- the headline (proofs/s, speedup vs the CPU baseline, rep spread);
+- the per-phase table from the ``variance`` section — median / min /
+  max / absolute and relative spread per replay phase across the timed
+  reps, with the dominant phase (largest absolute spread) starred.
+  This is the attributed form of the old bare "vrf spread 45%" warning:
+  the starred row names WHERE the cross-rep seconds moved;
+- the precompute cache stats (hit/miss/device_fill/eviction);
+- the registry metrics snapshot (the deterministic subset bench embeds).
+
+Rounds recorded before the observability layer (ISSUE 7) lack the
+``phases``/``variance``/``metrics`` sections; each missing section is
+reported as absent rather than failing, so the CLI works across the
+whole BENCH_r*.json history.
+
+Exit codes: 0 report printed, 2 unreadable/unrecognised input.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import List, Optional
+
+from ouroboros_tpu.observe.spans import PHASES  # jax-free
+
+PHASE_ORDER = PHASES + ("other",)
+
+
+def load_bench(path: str) -> dict:
+    """The bench result object from `path` — unwraps a harness record's
+    ``parsed`` field and tolerates a list of parsed JSON lines (the
+    replay headline is the dict carrying ``metric``)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "parsed" in doc and "metric" not in doc:
+        doc = doc["parsed"]
+    if isinstance(doc, list):
+        dicts = [d for d in doc if isinstance(d, dict) and "metric" in d]
+        if not dicts:
+            raise ValueError("no bench result object in JSON list")
+        doc = dicts[-1]
+    if not isinstance(doc, dict) or "metric" not in doc:
+        raise ValueError("not a bench result (no 'metric' field)")
+    return doc
+
+
+def _table(rows: List[List[str]], header: List[str]) -> List[str]:
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [fmt.format(*header), fmt.format(*("-" * w for w in widths))]
+    lines += [fmt.format(*(str(c) for c in row)) for row in rows]
+    return lines
+
+
+def _fmt_secs(v) -> str:
+    return f"{v:.4f}" if isinstance(v, (int, float)) else "-"
+
+
+def render(doc: dict) -> str:
+    out: List[str] = []
+
+    # -- headline -----------------------------------------------------------
+    out.append(f"{doc.get('metric', '?')}: {doc.get('value', '?')} "
+               f"{doc.get('unit', '')}".rstrip())
+    if "vs_baseline" in doc:
+        out.append(f"  vs CPU baseline: {doc['vs_baseline']}x"
+                   f"  (reps={doc.get('reps', '?')}, "
+                   f"rep spread={doc.get('spread', '?')})")
+    bd = doc.get("breakdown")
+    if bd:
+        out.append(f"  breakdown: device {bd.get('device_secs')}s / "
+                   f"host {bd.get('host_secs')}s")
+
+    # -- phase variance -----------------------------------------------------
+    out.append("")
+    var = doc.get("variance") or {}
+    per_phase = var.get("per_phase")
+    if per_phase:
+        out.append("per-phase seconds across timed reps "
+                   "(* = largest absolute spread):")
+        dom = var.get("dominant_phase")
+        rows = []
+        for ph in PHASE_ORDER:
+            st = per_phase.get(ph)
+            if st is None:
+                continue
+            rows.append([("*" if ph == dom else " ") + ph,
+                         _fmt_secs(st.get("median")),
+                         _fmt_secs(st.get("min")),
+                         _fmt_secs(st.get("max")),
+                         _fmt_secs(st.get("spread_secs")),
+                         st.get("spread_rel", "-")])
+        out += _table(rows, ["phase", "median", "min", "max",
+                             "spread_s", "rel"])
+        if dom is not None:
+            out.append(f"largest cross-rep spread: '{dom}' "
+                       f"({var.get('dominant_spread_secs')}s min->max) — "
+                       f"the phase to blame for rep-to-rep variance")
+    else:
+        out.append("no 'variance' section (round predates the "
+                   "observability layer)")
+
+    # -- precompute cache ---------------------------------------------------
+    out.append("")
+    pc = doc.get("precompute")
+    if pc:
+        out.append("precompute cache:")
+        out += _table([[k, pc[k]] for k in sorted(pc)],
+                      ["stat", "value"])
+    else:
+        out.append("no 'precompute' section")
+
+    # -- metrics snapshot ---------------------------------------------------
+    out.append("")
+    snap = doc.get("metrics")
+    if snap:
+        out.append("metrics snapshot (deterministic subset):")
+        rows = []
+        for name in sorted(snap):
+            v = snap[name]
+            if isinstance(v, dict):       # histogram
+                v = f"count={v.get('count')} sum={v.get('sum')}"
+            rows.append([name, v])
+        out += _table(rows, ["metric", "value"])
+    else:
+        out.append("no 'metrics' section")
+    return "\n".join(out) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print(__doc__.split("\n\n")[0] + "\n\n"
+              "usage: python -m tools.obsreport BENCH_rNN.json",
+              file=sys.stderr)
+        return 2
+    try:
+        doc = load_bench(argv[0])
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"obsreport: cannot read {argv[0]}: {e}", file=sys.stderr)
+        return 2
+    sys.stdout.write(render(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
