@@ -22,7 +22,6 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
 
 from ..crypto.backend import CryptoBackend, default_backend
-from ..observe import spans as _spans
 from .header_validation import (
     HeaderError, HeaderState, validate_envelope, revalidate_header,
 )
@@ -211,13 +210,15 @@ def replay_blocks_pipelined(
         ext_state: ExtLedgerState,
         backend: Optional[CryptoBackend] = None,
         window: int = 512) -> ReplayResult:
-    """Software-pipelined replay: while the device verifies window w's
-    proof batch, the host already runs window w+1's sequential pass — and
-    window w's device call ALSO computes the VRF betas window w+2's
-    sequential pass will need (backend.submit_window), so the latency-
-    bound host<->device link is crossed once per window, overlapped with
-    host work.  (Two windows ahead because window w's results are only
-    fetched after window w+1's sequential pass has begun.)
+    """Producer/consumer-pipelined replay: a background producer thread
+    runs window w+1's sequential pass, request packing and async submit
+    WHILE the caller thread blocks on window w's device results — host
+    and device time genuinely overlap instead of adding (the r5 version
+    interleaved both halves on one thread, so they could not).  Window
+    w's device call also computes the VRF betas window w+2's sequential
+    pass will need, installed at drain time; the producer's permit gate
+    keeps it exactly within that beta-carry distance
+    (consensus/pipeline.py has the protocol).
 
     `blocks` may be any iterable — windows are consumed with a bounded
     look-ahead, so a mainnet-scale replay streams without buffering the
@@ -234,27 +235,24 @@ def replay_blocks_pipelined(
     previous window's buffers instead of allocating fresh ones, and the
     cross-window precomputation cache (crypto/precompute.py) means a
     warm window ships no per-key decompression or table-build work at
-    all — only the ladders themselves.
+    all — only the ladders themselves.  On backends with
+    `supports_window_fold` the drain is a device-folded WindowVerdict
+    (one scalar pair) instead of a per-proof vector.
 
     Falls back to the synchronous windowed driver on backends without
     submit_window."""
     import itertools
 
     backend = backend or default_backend()
-    protocol, ledger = ext_rules.protocol, ext_rules.ledger
     submit = getattr(backend, "submit_window", None)
-    block_iter = iter(blocks)
-
-    def next_window():
-        w = list(itertools.islice(block_iter, window))
-        return w or None
 
     if submit is None:
+        block_iter = iter(blocks)
         st = ext_state
         done = 0
         while True:
-            w = next_window()
-            if w is None:
+            w = list(itertools.islice(block_iter, window))
+            if not w:
                 break
             res = validate_blocks_batched(ext_rules, w, st,
                                           backend=backend)
@@ -267,119 +265,6 @@ def replay_blocks_pipelined(
             st = res.final_state
         return ReplayResult(st, done, None)
 
-    from collections import deque
-
-    from ..crypto.backend import GLOBAL_BETA_CACHE
-    # bounded look-ahead: ahead[0] = current window, ahead[1:] = the two
-    # windows whose beta proofs may already be in flight
-    ahead: deque = deque()
-    for _ in range(3):
-        w = next_window()
-        if w is None:
-            break
-        ahead.append(([getattr(b, "header", b) for b in w], w))
-    if ahead:
-        # windows 0 and 1 ride a plain prefetch; window w's device call
-        # then carries window w+2's betas
-        protocol.prefetch_window(
-            [h for hs, _w in list(ahead)[:2] for h in hs], backend)
-
-    st = ext_state
-    # TWO windows in flight: window w's device work has the host passes of
-    # w+1 AND w+2 (plus their dispatch prep) to complete under before its
-    # drain blocks — one-deep left the drain waiting on most of the device
-    # time.  Depth 2 is exactly the beta carry distance: w's submit ships
-    # w+2's betas, and the drain of w at the top of iteration w+2 installs
-    # them right before w+2's sequential pass needs them.
-    pending: deque = deque()
-    depth = 2
-    done = 0
-
-    def drain(entry):
-        """Finish a window's device call.  Returns (error, n_valid):
-        error None when every proof held, else the global index of the
-        first bad block is start + first_bad."""
-        start, sub, reqs, owner, n_seq_w = entry
-        ok, betas = backend.finish_window(sub)
-        if betas:
-            GLOBAL_BETA_CACHE.store_many(betas.keys(), betas.values())
-        first_bad, bad = n_seq_w, None
-        for j, good in enumerate(ok):
-            if not good and owner[j] < first_bad:
-                first_bad, bad = owner[j], j
-        if bad is not None:
-            return LedgerError(
-                f"proof {type(reqs[bad]).__name__} failed for block "
-                f"{start + first_bad}"), start + first_bad
-        return None, start + n_seq_w
-
-    def drain_all():
-        """Drain every in-flight window oldest-first; first error wins."""
-        while pending:
-            err, n_ok = drain(pending.popleft())
-            if err is not None:
-                for later in pending:
-                    backend.finish_window(later[1])
-                return err, n_ok
-        return None, done
-
-    while ahead:
-        if len(pending) >= depth:
-            # completes window w-2, installing the betas this iteration's
-            # sequential pass is about to read
-            err, n_ok = drain(pending.popleft())
-            if err is not None:
-                for later in pending:
-                    backend.finish_window(later[1])
-                return ReplayResult(None, n_ok, err)
-        headers_w, blk_window = ahead.popleft()
-        nxt = next_window()
-        if nxt is not None:
-            ahead.append(([getattr(b, "header", b) for b in nxt], nxt))
-        reqs: list = []
-        owner: list[int] = []
-        seq_error: Optional[Exception] = None
-        n_seq_w = 0
-        with _spans.span("window.host_seq", cat="host-seq"):
-            for i, b in enumerate(blk_window):
-                try:
-                    rs, st = _seq_block_step(protocol, ledger, st, b)
-                except OutsideForecastRange as e:
-                    # retry-later, never invalid (see
-                    # validate_blocks_batched)
-                    seq_error = e
-                    break
-                except Exception as e:
-                    seq_error = (e if isinstance(e, (HeaderError,
-                                                     LedgerError))
-                                 else LedgerError(str(e)))
-                    break
-                reqs.extend(rs)
-                owner.extend([i] * len(rs))
-                n_seq_w += 1
-
-        # carry betas for the window TWO ahead (ahead[1] after the pop):
-        # they are fetched at drain time, which precedes that window's
-        # sequential pass
-        next_proofs = (protocol.vrf_proofs_of(ahead[1][0])
-                       if len(ahead) > 1 and seq_error is None else ())
-        next_proofs = [p for p in next_proofs
-                       if p not in GLOBAL_BETA_CACHE]
-        done_before = done
-        done += n_seq_w
-        pending.append((done_before, submit(reqs, next_proofs), reqs,
-                        owner, n_seq_w))
-        if seq_error is not None:
-            err, n_ok = drain_all()
-            if err is not None:
-                return ReplayResult(None, n_ok, err)
-            # the valid prefix (incl. the drained proofs) is fully
-            # verified: resumable when the error is retry-later
-            resume = (st if isinstance(seq_error, OutsideForecastRange)
-                      else None)
-            return ReplayResult(resume, done, seq_error)
-
-    err, n_ok = drain_all()
-    if err is not None:
-        return ReplayResult(None, n_ok, err)
-    return ReplayResult(st, done, None)
+    from .pipeline import replay_threaded
+    return replay_threaded(ext_rules, blocks, ext_state, backend,
+                           window=window)

@@ -1,0 +1,296 @@
+"""Threaded producer/consumer replay — true host/device overlap.
+
+The r5 software pipeline (consensus/batch.py) kept two windows in
+flight, but the sequential pass, request packing, and dispatch all ran
+on ONE Python thread: while that thread sat inside a blocking drain
+(the packed result transfer plus result folding), no host-sequential
+work advanced, so host-seq and device time simply ADDED in the bench
+breakdown (BENCH_r05: 0.87s + 3.79s).  SURVEY.md hard parts #3 says the
+split is legal — nonce evolution is sequential, but proofs are
+state-independent once seeds are derived — so this module puts the host
+half on its own thread:
+
+    producer (background thread)      consumer (caller thread)
+    ------------------------------    ------------------------------
+    window w+1: seq pass              window w: blocking drain
+               (nonce evolution,        (ONE packed transfer; with
+                envelope checks,         fold=True just a verdict
+                proof extraction)        scalar + betas)
+               request packing          install carried betas
+               key-cache prefetch       release one permit
+               async submit  ───────►   first error wins, oldest-first
+
+Coordination protocol (mirrored 1:1 by the sim model explored under
+ouro-race in tests/test_replay_pipeline.py):
+
+  * one Condition guards {pending, submitted, drained, stop, done};
+  * the producer acquires a PERMIT before each window's sequential
+    pass: it waits until ``submitted - drained < DEPTH`` — exactly the
+    beta-carry distance.  Window w's submit ships window w+2's betas,
+    which the consumer installs when draining w, immediately before the
+    producer's sequential pass for w+2 reads them.  Running further
+    ahead would silently fall back to per-proof host EC math;
+  * the consumer drains oldest-first outside the lock (the blocking
+    device wait must not hold it), installs betas, then releases the
+    permit;
+  * on a drain error the consumer sets ``stop``; the producer observes
+    it at the next permit check, so at most one more window is ever
+    submitted, and the consumer discards the leftovers with
+    finish_window so no device work is leaked;
+  * the producer NEVER touches the result: seq counts, the final state
+    and any sequential error hand over through the shared state after
+    ``done``, and an unexpected producer exception re-raises on the
+    caller thread (``crash``).
+
+Scheduling cannot change the outcome: drains are processed in
+submission order and the first error wins, so ReplayResult is
+byte-identical to the synchronous driver on any chain, valid or not —
+tests/test_replay_pipeline.py pins this.
+
+Shared-cache discipline: the producer owns all point-cache fills and
+beta-cache reads; the consumer owns beta-cache writes and KES hash-path
+outcome writes.  Individual dict operations are GIL-atomic and every
+value is a pure function of its key, so a racing read at worst
+recomputes; the caches' LRU bookkeeping (recency touches, capacity
+eviction) additionally tolerates a concurrent eviction from the other
+thread — see precompute._insert / VrfBetaCache._store.  Span trees are per-thread (observe/spans.py): the producer's
+``window.host_seq``/``window.submit`` roots and the consumer's
+``window.drain`` roots overlap in wall time — which is the point — and
+bench.py's ``overlap`` section measures exactly that hiding.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from collections import deque
+from typing import Any, Optional
+
+from ..crypto.backend import GLOBAL_BETA_CACHE, WindowVerdict
+from ..observe import metrics as _metrics
+from ..observe import spans as _spans
+from .header_validation import HeaderError
+from .ledger import LedgerError, OutsideForecastRange
+
+#: max windows submitted-but-not-drained while a sequential pass runs —
+#: the beta-carry distance (window w's device call computes w+2's betas)
+DEPTH = 2
+
+# load-bearing thread accounting (always on): a replay that returns with
+# started != finished leaked its producer — bench --smoke asserts the
+# pair equal after the pipelined parity probe
+_STARTED = _metrics.counter("pipeline.producers_started", always=True)
+_FINISHED = _metrics.counter("pipeline.producers_finished", always=True)
+# observational: windows through the pipeline / producer permit stalls
+_WINDOWS = _metrics.counter("pipeline.windows")
+_STALLS = _metrics.counter("pipeline.producer_stalls")
+
+
+class _Shared:
+    """Producer/consumer handoff state; every field below is guarded by
+    ``cond`` except the producer-private ones it publishes only before
+    setting ``done``."""
+
+    __slots__ = ("cond", "pending", "submitted", "drained", "stop",
+                 "done", "crash", "seq_error", "seq_done", "final_state")
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.pending: deque = deque()   # (start, sub, reqs, owner, n_seq)
+        self.submitted = 0
+        self.drained = 0
+        self.stop = False               # consumer: error seen, stop producing
+        self.done = False               # producer: no more submissions
+        self.crash: Optional[BaseException] = None
+        self.seq_error: Optional[Exception] = None
+        self.seq_done = 0               # blocks past the sequential pass
+        self.final_state: Any = None
+
+
+def _produce(shared: _Shared, ext_rules, block_iter, ext_state, backend,
+             window: int, fold: bool) -> None:
+    """Producer body: sequential pass + packing + async submit per
+    window, permit-gated to the beta-carry depth."""
+    protocol, ledger = ext_rules.protocol, ext_rules.ledger
+    submit = backend.submit_window
+
+    def next_window():
+        w = list(itertools.islice(block_iter, window))
+        return w or None
+
+    try:
+        # bounded look-ahead: ahead[0] = current window, ahead[1:] = the
+        # two windows whose beta proofs may already be in flight
+        ahead: deque = deque()
+        for _ in range(3):
+            w = next_window()
+            if w is None:
+                break
+            ahead.append(([getattr(b, "header", b) for b in w], w))
+        if ahead:
+            # windows 0 and 1 ride a plain prefetch; window w's device
+            # call then carries window w+2's betas
+            protocol.prefetch_window(
+                [h for hs, _w in list(ahead)[:2] for h in hs], backend)
+
+        st = ext_state
+        while ahead:
+            with shared.cond:
+                if not (shared.stop
+                        or shared.submitted - shared.drained < DEPTH):
+                    _STALLS.inc()
+                    with _spans.span("producer.stall", cat="stall"):
+                        shared.cond.wait_for(
+                            lambda: shared.stop or
+                            shared.submitted - shared.drained < DEPTH)
+                if shared.stop:
+                    return
+            headers_w, blk_window = ahead.popleft()
+            nxt = next_window()
+            if nxt is not None:
+                ahead.append(([getattr(b, "header", b) for b in nxt],
+                              nxt))
+            reqs: list = []
+            owner: list[int] = []
+            seq_error: Optional[Exception] = None
+            n_seq_w = 0
+            with _spans.span("window.host_seq", cat="host-seq"):
+                for i, b in enumerate(blk_window):
+                    try:
+                        rs, st = _seq_block_step(protocol, ledger, st, b)
+                    except OutsideForecastRange as e:
+                        # retry-later, never invalid (see
+                        # validate_blocks_batched)
+                        seq_error = e
+                        break
+                    except Exception as e:
+                        seq_error = (e if isinstance(e, (HeaderError,
+                                                         LedgerError))
+                                     else LedgerError(str(e)))
+                        break
+                    reqs.extend(rs)
+                    owner.extend([i] * len(rs))
+                    n_seq_w += 1
+            # carry betas for the window TWO ahead (ahead[1] after the
+            # pop): the consumer installs them at drain time, which the
+            # permit above orders before that window's sequential pass
+            next_proofs = (protocol.vrf_proofs_of(ahead[1][0])
+                           if len(ahead) > 1 and seq_error is None else ())
+            next_proofs = [p for p in next_proofs
+                           if p not in GLOBAL_BETA_CACHE]
+            sub = (submit(reqs, next_proofs, fold=True) if fold
+                   else submit(reqs, next_proofs))
+            _WINDOWS.inc()
+            with shared.cond:
+                shared.pending.append(
+                    (shared.seq_done, sub, reqs, owner, n_seq_w))
+                shared.submitted += 1
+                shared.seq_done += n_seq_w
+                shared.cond.notify_all()
+            if seq_error is not None:
+                shared.seq_error = seq_error
+                break
+        shared.final_state = st
+    except BaseException as e:      # submit/seq machinery broke: hand the
+        shared.crash = e            # exception to the caller thread
+    finally:
+        with shared.cond:
+            shared.done = True
+            shared.cond.notify_all()
+
+
+def _drain(backend, entry) -> tuple:
+    """Finish one window's device call; install its carried betas.
+    Returns (error, n_valid): error None when every proof held, else
+    n_valid is the global index of the first bad block."""
+    start, sub, reqs, owner, n_seq_w = entry
+    ok, betas = backend.finish_window(sub)
+    if betas:
+        GLOBAL_BETA_CACHE.store_many(betas.keys(), betas.values())
+    if isinstance(ok, WindowVerdict):
+        # device-folded form: the first failing request index directly
+        # (owner maps are non-decreasing, so the first bad request is
+        # also the first bad block)
+        bad, first_bad = ok.first_bad, n_seq_w
+        if bad is not None:
+            first_bad = owner[bad]
+    else:
+        first_bad, bad = n_seq_w, None
+        for j, good in enumerate(ok):
+            if not good and owner[j] < first_bad:
+                first_bad, bad = owner[j], j
+    if bad is not None:
+        return LedgerError(
+            f"proof {type(reqs[bad]).__name__} failed for block "
+            f"{start + first_bad}"), start + first_bad
+    return None, start + n_seq_w
+
+
+def replay_threaded(ext_rules, blocks, ext_state, backend,
+                    window: int = 512):
+    """Run the producer/consumer pipeline to completion; returns the
+    same ReplayResult the synchronous driver would (batch.py re-exports
+    this as the submit_window path of replay_blocks_pipelined)."""
+    from .batch import ReplayResult
+
+    fold = bool(getattr(backend, "supports_window_fold", False))
+    shared = _Shared()
+    t = threading.Thread(
+        target=_run_producer,
+        args=(shared, ext_rules, iter(blocks), ext_state, backend,
+              window, fold),
+        name="ouro-replay-producer", daemon=True)
+    _STARTED.inc()
+    t.start()
+    error: Optional[Exception] = None
+    n_ok = 0
+    try:
+        while True:
+            with shared.cond:
+                shared.cond.wait_for(
+                    lambda: shared.pending or shared.done)
+                if not shared.pending:
+                    break               # done and fully drained
+                entry = shared.pending.popleft()
+            err, n = _drain(backend, entry)      # blocking, lock NOT held
+            with shared.cond:
+                shared.drained += 1
+                shared.cond.notify_all()
+            if err is not None:
+                error, n_ok = err, n
+                break
+    finally:
+        # wake a permit-blocked producer and wait it out — the pipeline
+        # must never leak its thread, least of all on an error path
+        with shared.cond:
+            shared.stop = True
+            shared.cond.notify_all()
+        t.join()
+        # discard anything submitted after the first error (or after a
+        # consumer-side exception): the async device work must complete
+        for entry in shared.pending:
+            backend.finish_window(entry[1])
+        shared.pending.clear()
+    if shared.crash is not None:
+        raise shared.crash
+    if error is not None:
+        return ReplayResult(None, n_ok, error)
+    if shared.seq_error is not None:
+        # the valid prefix (incl. the drained proofs) is fully verified:
+        # resumable when the error is retry-later
+        resume = (shared.final_state
+                  if isinstance(shared.seq_error, OutsideForecastRange)
+                  else None)
+        return ReplayResult(resume, shared.seq_done, shared.seq_error)
+    return ReplayResult(shared.final_state, shared.seq_done, None)
+
+
+def _run_producer(*args) -> None:
+    try:
+        _produce(*args)
+    finally:
+        _FINISHED.inc()
+
+
+# placed at the bottom to avoid a circular import at module load
+# (batch.py imports replay_threaded; we only need its seq step)
+from .batch import _seq_block_step  # noqa: E402

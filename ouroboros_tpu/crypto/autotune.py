@@ -38,7 +38,11 @@ from ..utils.tracer import Tracer
 
 # bump when kernel internals change enough that a persisted pallas-vs-XLA
 # choice could be stale (the choices file is keyed by this revision)
-KERNEL_REV = "r6-precompute-1"
+# r8: the simple-batch VRF path moved to the verify+challenge-fold form
+# (device SHA-512, 1 B/proof transfer) under its own ("vrff", m) key;
+# ("vrf", m) still names the rows form the window composite fuses.  r6
+# choice files predate the split and must re-measure.
+KERNEL_REV = "r8-fold-1"
 
 WARMUP_REPS = 1
 TIMED_REPS = 3

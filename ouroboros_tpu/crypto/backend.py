@@ -50,10 +50,42 @@ class KesReq:
     sig_bytes: bytes
 
 
+@dataclass(frozen=True)
+class WindowVerdict:
+    """Folded window verdict: what finish_window returns when the window
+    was submitted with `fold=True` (device-side verdict reduction).
+
+    Instead of a per-proof boolean vector crossing the host<->device
+    link, the fused window program folds ok-flags on device and returns
+    only the FIRST failing request's index (None = every proof held).
+    `first_bad` indexes the submitted request list, so a replay driver
+    maps it through its owner table exactly like `min(owner[j] for bad
+    j)` over the old vector — owner maps are non-decreasing, making the
+    first bad request also the first bad block."""
+    n: int
+    first_bad: Optional[int] = None
+
+    @property
+    def all_ok(self) -> bool:
+        return self.first_bad is None
+
+    def as_bools(self) -> list:
+        """Degraded vector view: True everywhere except first_bad.  Only
+        exact when at most one request failed — callers needing the full
+        vector must submit with fold=False."""
+        out = [True] * self.n
+        if self.first_bad is not None:
+            out[self.first_bad] = False
+        return out
+
+
 class CryptoBackend:
     """Batch verification interface. Implementations must be bit-exact."""
 
     name = "abstract"
+    # True on backends whose submit_window/pack_window accept fold=True
+    # (device-side verdict reduction — consensus/pipeline.py asks)
+    supports_window_fold = False
 
     def verify_ed25519_batch(self, reqs: Sequence[Ed25519Req]) -> list[bool]:
         raise NotImplementedError
@@ -218,10 +250,13 @@ class VrfBetaCache:
     def _store(self, proof: bytes, beta) -> None:
         if len(self._cache) >= self.max_entries:
             # evict the oldest half (insertion order), never the entries
-            # just prefetched for the in-flight window
+            # just prefetched for the in-flight window; pop-with-default
+            # because the pipelined replay's producer (miss-path get) and
+            # consumer (store_many at drain) may both evict concurrently
+            # over stale key snapshots
             drop = len(self._cache) // 2
             for k in list(self._cache)[:drop]:
-                del self._cache[k]
+                self._cache.pop(k, None)
         self._cache[proof] = beta
 
     def clear(self) -> None:

@@ -47,6 +47,12 @@ from .precompute import GLOBAL_PRECOMPUTE_CACHE
 # observational (gated) counters: window/dispatch volume on the hot path
 _WINDOWS = _metrics.counter("jax_backend.windows_submitted")
 _COMPOSITE_BUILDS = _metrics.counter("jax_backend.composite_builds")
+_FOLD_WINDOWS = _metrics.counter("jax_backend.fold_windows")
+
+# device-side verdict-fold sentinel: "no failing request".  int32 max so
+# jnp.min over any real request index beats it; request lists are bounded
+# far below it (a window is ~thousands of proofs).
+FOLD_SENT = 0x7FFFFFFF
 
 
 def _compile_span_on_first_call(fn, name: str):
@@ -98,6 +104,10 @@ def _pad_words(w: np.ndarray, m: int) -> np.ndarray:
 
 class JaxBackend(CryptoBackend):
     name = "jax-tpu"
+    # submit_window(fold=True) folds verdicts on device into one
+    # WindowVerdict scalar instead of a per-proof vector (the
+    # producer/consumer replay driver asks — consensus/pipeline.py)
+    supports_window_fold = True
 
     def __init__(self, min_bucket: int = 128, use_pallas: bool | None = None,
                  autotune: bool | None = None):
@@ -121,6 +131,8 @@ class JaxBackend(CryptoBackend):
             min_bucket = max(min_bucket, PK.TILE)
         self.min_bucket = min_bucket
         self._composites: dict = {}   # (ne, nv, nb, nk, pallas) -> program
+        self._folds: dict = {}        # (ne, nv, nb, nk) -> fold program
+        self._pk_vrf_folds: dict = {} # m -> jitted pallas verify+fold
         # donate the window inputs to the composite so a warm-path window
         # reuses the previous window's device buffers instead of
         # reallocating (XLA:CPU ignores donation with a warning -> gate)
@@ -133,6 +145,18 @@ class JaxBackend(CryptoBackend):
                        if autotune else None)
         # static-path choices recorded for kernel_choices() reporting
         self._static_choice: dict = {}
+
+    # -- subclass seams (ShardedJaxBackend overrides both) -------------------
+    def _pad(self, n: int) -> int:
+        """Batch padding: power-of-two buckets here; the mesh backend
+        additionally rounds to a mesh-size multiple."""
+        return _bucket(n, self.min_bucket)
+
+    def _dev(self, a):
+        """Host array -> device array for a lane-axis-last batch input;
+        the mesh backend device_puts with the window-axis sharding."""
+        import jax.numpy as jnp
+        return jnp.asarray(a)
 
     # -- measured kernel selection ------------------------------------------
     @property
@@ -168,7 +192,6 @@ class JaxBackend(CryptoBackend):
         to m.  Returns (dev_args, parse_ok); keys the cache could not
         decompress are masked out of parse_ok (the kernels trust the
         cached affine x and skip the A square root)."""
-        import jax.numpy as jnp
         pad = m - len(reqs)
         vks = [r.vk for r in reqs] + [b"\x00" * 32] * pad
         arrays, parse_ok = EJ.prepare_words_batch(
@@ -177,10 +200,10 @@ class JaxBackend(CryptoBackend):
             [r.sig for r in reqs] + [b"\x00" * 64] * pad)
         Aw, _signA, Rw, signR, sw, kw = arrays
         xa, xw, yw, known = EJ.GLOBAL_A128_CACHE.assemble(vks)
-        args = (jnp.asarray(Aw), jnp.asarray(xa),
-                jnp.asarray(xw), jnp.asarray(yw),
-                jnp.asarray(Rw), jnp.asarray(signR.reshape(1, -1)),
-                jnp.asarray(sw), jnp.asarray(kw))
+        args = (self._dev(Aw), self._dev(xa),
+                self._dev(xw), self._dev(yw),
+                self._dev(Rw), self._dev(signR.reshape(1, -1)),
+                self._dev(sw), self._dev(kw))
         return args, parse_ok & known
 
     def _ed_dispatch(self, args, m: int, use_pallas: bool):
@@ -195,7 +218,7 @@ class JaxBackend(CryptoBackend):
         if not reqs:
             return []
         n = len(reqs)
-        m = _bucket(n, self.min_bucket)
+        m = self._pad(n)
         args, parse_ok = self._prep_ed(reqs, m)
         use, ok = self._pick(
             ("ed", m),
@@ -207,8 +230,6 @@ class JaxBackend(CryptoBackend):
                 for o, p in zip(ok[:n], parse_ok[:n])]
 
     def _prep_vrf(self, reqs, m: int):
-        import jax.numpy as jnp
-
         from . import vrf_jax
         pad = m - len(reqs)
         vks = [r.vk for r in reqs] + [b"\x00" * 32] * pad
@@ -218,9 +239,9 @@ class JaxBackend(CryptoBackend):
             [r.proof for r in reqs] + [b"\x00" * 80] * pad)
         Yw, _signY, Gw, signG, rw, cw, sw = args
         xa, _x128, _y128, known = EJ.GLOBAL_A128_CACHE.assemble(vks)
-        dev = (jnp.asarray(Yw), jnp.asarray(xa),
-               jnp.asarray(Gw), jnp.asarray(signG.reshape(1, -1)),
-               jnp.asarray(rw), jnp.asarray(cw), jnp.asarray(sw))
+        dev = (self._dev(Yw), self._dev(xa),
+               self._dev(Gw), self._dev(signG.reshape(1, -1)),
+               self._dev(rw), self._dev(cw), self._dev(sw))
         return dev, (parse_ok & known, gamma_ok, s_ok, pf_arr)
 
     def _vrf_dispatch(self, dev, m: int, use_pallas: bool):
@@ -231,22 +252,56 @@ class JaxBackend(CryptoBackend):
         return vrf_jax.vrf_verify_words_kernel(Yw, xa, Gw,
                                                signG2[0], rw, cw, sw)
 
+    def _vrf_fold_dispatch(self, dev, gamma_b, c_b, valid, m: int,
+                           use_pallas: bool):
+        """Verify + on-device challenge fold: (m,) uint8 verdicts.  The
+        (m, 130) point rows never leave the device — 1 B/proof crosses
+        the link instead of 130 B (the r5 primitive's drain shipped
+        ~266 KB/rep over a ~20 MB/s tunnel, and that transfer's jitter
+        was the prime suspect for the 45% BENCH_r05 vrf spread)."""
+        from . import vrf_jax
+        if use_pallas:
+            fn = self._pk_vrf_folds.get(m)
+            if fn is None:
+                import jax
+                import jax.numpy as jnp
+                PK = self._pk
+
+                def call(Yw, xa, Gw, signG2, rw, cw, sw, gb, cb, va,
+                         _m=m):
+                    rows = PK._vrf_verify_call(Yw, xa, Gw, signG2, rw,
+                                               cw, sw, _m)
+                    ok = vrf_jax.challenge_ok_device(rows, gb, cb)
+                    return (ok & (va != 0)).astype(jnp.uint8)
+                fn = self._pk_vrf_folds[m] = jax.jit(call)
+            return fn(*dev, gamma_b, c_b, valid)
+        Yw, xa, Gw, signG2, rw, cw, sw = dev
+        return vrf_jax.vrf_verify_fold_words_kernel(
+            Yw, xa, Gw, signG2[0], rw, cw, sw, gamma_b, c_b, valid)
+
     def verify_vrf_batch(self, reqs):
         if not reqs:
             return []
-        from . import vrf_jax
         n = len(reqs)
-        m = _bucket(n, self.min_bucket)
+        m = self._pad(n)
         dev, (parse_ok, gamma_ok, s_ok, pf_arr) = self._prep_vrf(reqs, m)
-        use, rows = self._pick(
-            ("vrf", m),
-            lambda: np.asarray(self._vrf_dispatch(dev, m, True)),
-            lambda: np.asarray(self._vrf_dispatch(dev, m, False)))
-        if rows is None:
-            rows = np.asarray(self._vrf_dispatch(dev, m, use))
-        oks, _betas = vrf_jax._finish(rows, parse_ok, gamma_ok,
-                                      s_ok, pf_arr, n)
-        return oks
+        gamma_b = self._dev(np.ascontiguousarray(pf_arr[:, :32]))
+        c_b = self._dev(np.ascontiguousarray(pf_arr[:, 32:48]))
+        valid = self._dev(parse_ok.astype(np.uint8))
+        # own key: this measures the verify+challenge-fold program pair,
+        # a different program than the ("vrf", m) rows form the window
+        # composite fuses — sharing the key would pin a choice measured
+        # on the wrong program for whichever path ran second
+        use, ok = self._pick(
+            ("vrff", m),
+            lambda: np.asarray(self._vrf_fold_dispatch(
+                dev, gamma_b, c_b, valid, m, True)),
+            lambda: np.asarray(self._vrf_fold_dispatch(
+                dev, gamma_b, c_b, valid, m, False)))
+        if ok is None:
+            ok = np.asarray(self._vrf_fold_dispatch(dev, gamma_b, c_b,
+                                                    valid, m, use))
+        return [bool(o) for o in ok[:n]]
 
     # largest single gamma8 dispatch: bounds the set of compiled shapes
     # (a fresh pallas shape costs minutes through the AOT helper)
@@ -269,12 +324,11 @@ class JaxBackend(CryptoBackend):
                 out.extend(self.vrf_betas_batch(
                     proofs[off:off + self.BETA_CHUNK]))
             return out
-        import jax.numpy as jnp
-        m = _bucket(n, self.min_bucket)
+        m = self._pad(n)
         padded = list(proofs) + [b"\x00" * 80] * (m - n)
         (Gw, signG), decode_ok = vrf_jax._prepare_betas_words(padded)
-        Gwd = jnp.asarray(Gw)
-        signG2 = jnp.asarray(signG.reshape(1, -1))
+        Gwd = self._dev(Gw)
+        signG2 = self._dev(signG.reshape(1, -1))
         use, rows = self._pick(
             ("beta", m),
             lambda: np.asarray(self._beta_dispatch(Gwd, signG2, m, True)),
@@ -350,14 +404,13 @@ class JaxBackend(CryptoBackend):
                 kes_msgs, kes_expects, kes_checks, len(reqs))
 
     def _prep_kes_hash(self, kes_msgs, kes_expects, m: int):
-        import jax.numpy as jnp
         msgs = np.frombuffer(b"".join(kes_msgs), dtype=np.uint8)
         msgs = msgs.reshape(-1, 64)
         exps = np.frombuffer(b"".join(kes_expects), dtype=np.uint8)
         exps = exps.reshape(-1, 32)
         mw = _pad_words(B2.msg_words(msgs), m)
         ew = _pad_words(B2.digest_words(exps), m)
-        return jnp.asarray(mw), jnp.asarray(ew)
+        return self._dev(mw), self._dev(ew)
 
     def _kes_dispatch(self, mw, ew, m: int, use_pallas: bool):
         if use_pallas:
@@ -434,20 +487,28 @@ class JaxBackend(CryptoBackend):
         self._composites[key] = fn
         return fn
 
-    def submit_window(self, reqs, next_beta_proofs=()):
+    def submit_window(self, reqs, next_beta_proofs=(), fold: bool = False):
         """Dispatch one replay window's whole device workload — the mixed
         Ed25519/VRF/KES verification of `reqs` AND the VRF betas the NEXT
         window's sequential pass will need — as ONE fused device program
         whose results are packed into ONE flat uint8 array: the
         latency-bound host<->device link is crossed once per window, and
         the launch overhead is paid once instead of per kernel.  Returns
-        an opaque state for finish_window."""
+        an opaque state for finish_window.
+
+        With fold=True the per-proof verdicts never cross the link: a
+        second tiny device program reduces the composite's packed output
+        to the FIRST failing request index (on-device SHA-512 challenge
+        fold for VRF — sha512_jax), and finish_window returns a
+        WindowVerdict scalar pair instead of the boolean vector.  The
+        big ladder composite is SHARED between both modes (same program,
+        same autotuned choice, same compile), so a fold caller costs one
+        extra small compile, not a second composite."""
         with _spans.span("window.submit", cat="dispatch"):
-            return self._submit_window(reqs, next_beta_proofs)
+            return self._submit_window(reqs, next_beta_proofs, fold)
 
-    def _submit_window(self, reqs, next_beta_proofs=()):
-        import jax.numpy as jnp
-
+    def _submit_window(self, reqs, next_beta_proofs=(),
+                       fold: bool = False):
         from . import vrf_jax
         _WINDOWS.inc()
         (ed_reqs, ed_owner, vrf_reqs, vrf_owner,
@@ -458,22 +519,22 @@ class JaxBackend(CryptoBackend):
         ne = nv = nb = nk = 0
         ed_args = vrf_args = beta_args = kes_args = None
         if ed_reqs:
-            ne = _bucket(len(ed_reqs), self.min_bucket)
+            ne = self._pad(len(ed_reqs))
             ed_args, parse_ok = self._prep_ed(ed_reqs, ne)
             ed_state = (None, parse_ok)
         if vrf_reqs:
-            nv = _bucket(len(vrf_reqs), self.min_bucket)
+            nv = self._pad(len(vrf_reqs))
             vrf_args, masks = self._prep_vrf(vrf_reqs, nv)
             vrf_state = (None,) + masks
         if beta_proofs:
-            nb = _bucket(len(beta_proofs), self.min_bucket)
+            nb = self._pad(len(beta_proofs))
             padded = beta_proofs + [b"\x00" * 80] * (nb - len(beta_proofs))
             (Gw, signG), decode_ok = vrf_jax._prepare_betas_words(padded)
             beta_state = (decode_ok,)
-            beta_args = (jnp.asarray(Gw),
-                         jnp.asarray(signG.reshape(1, -1)))
+            beta_args = (self._dev(Gw),
+                         self._dev(signG.reshape(1, -1)))
         if kes_msgs:
-            nk = _bucket(len(kes_msgs), self.min_bucket)
+            nk = self._pad(len(kes_msgs))
             kes_args = self._prep_kes_hash(kes_msgs, kes_expects, nk)
         if (ed_args is None and vrf_args is None and beta_args is None
                 and kes_args is None):
@@ -483,13 +544,114 @@ class JaxBackend(CryptoBackend):
                                        beta_args, kes_args)
             packed = self._window_composite(ne, nv, nb, nk, allp)(
                 ed_args, vrf_args, beta_args, kes_args)
-        return {"packed": packed, "n": n,
-                "ed": ed_state, "ed_owner": ed_owner, "ne": ne,
-                "vrf": vrf_state, "vrf_owner": vrf_owner,
-                "vrf_n": len(vrf_reqs), "nv": nv,
-                "beta": beta_state, "beta_proofs": beta_proofs, "nb": nb,
-                "kes_checks": kes_checks, "nk": nk,
-                "kes_n": len(kes_msgs)}
+        state = {"packed": packed, "n": n,
+                 "ed": ed_state, "ed_owner": ed_owner, "ne": ne,
+                 "vrf": vrf_state, "vrf_owner": vrf_owner,
+                 "vrf_n": len(vrf_reqs), "nv": nv,
+                 "beta": beta_state, "beta_proofs": beta_proofs, "nb": nb,
+                 "kes_checks": kes_checks, "nk": nk,
+                 "kes_n": len(kes_msgs)}
+        if fold:
+            self._attach_fold(state, reqs)
+        return state
+
+    def _attach_fold(self, state, reqs) -> None:
+        """Reduce the window's packed verdict buffer on device to [first
+        failing request index (4 B LE) | KES job flags | beta rows].
+
+        Host-known failures (undecodable keys/sigs, structurally invalid
+        KES, known-bad cached hash paths) never reach the device fold:
+        their lanes carry the sentinel owner and their minimum index is
+        kept in `host_first_bad` for finish_window to merge.  The KES
+        job flags still cross the link raw — they exist only on COLD
+        hash paths and the precompute cache must see each path's
+        outcome; warm windows ship zero of them."""
+        import jax.numpy as jnp
+        _FOLD_WINDOWS.inc()
+        n = state["n"]
+        ne, nv = state["ne"], state["nv"]
+        covered = np.zeros(max(n, 1), dtype=bool)
+        host_bad = FOLD_SENT
+        ed_own = np.full(ne, FOLD_SENT, np.int32)
+        if state["ed"] is not None:
+            po = np.asarray(state["ed"][1], dtype=bool)
+            for k, i in enumerate(state["ed_owner"]):
+                covered[i] = True
+                if po[k]:
+                    ed_own[k] = i
+                elif i < host_bad:
+                    host_bad = i
+        vrf_own = np.full(nv, FOLD_SENT, np.int32)
+        gamma_b = np.zeros((nv, 32), np.uint8)
+        c_b = np.zeros((nv, 16), np.uint8)
+        if state["vrf"] is not None:
+            _h, parse_ok, _gok, _sok, pf_arr = state["vrf"]
+            pv = np.asarray(parse_ok, dtype=bool)
+            gamma_b = np.ascontiguousarray(pf_arr[:, :32])
+            c_b = np.ascontiguousarray(pf_arr[:, 32:48])
+            for k, i in enumerate(state["vrf_owner"]):
+                covered[i] = True
+                if pv[k]:
+                    vrf_own[k] = i
+                elif i < host_bad:
+                    host_bad = i
+        uncovered = np.flatnonzero(~covered[:n])
+        if uncovered.size and uncovered[0] < host_bad:
+            host_bad = int(uncovered[0])
+        state["fold"] = True
+        state["host_first_bad"] = host_bad
+        if state["packed"] is not None:
+            state["packed"] = self._fold_program(
+                ne, nv, state["nb"], state["nk"])(
+                    state["packed"], jnp.asarray(ed_own),
+                    jnp.asarray(vrf_own), jnp.asarray(gamma_b),
+                    jnp.asarray(c_b))
+
+    def _fold_program(self, ne: int, nv: int, nb: int, nk: int):
+        """Jitted verdict reduction over one window's packed buffer.
+        Output layout: [first-bad index, uint32 LE (FOLD_SENT = none)
+        | nk KES job flags | nb*33 beta rows] — the transfer shrinks
+        from ne + 130*nv + ... to 4 + nk + 33*nb bytes."""
+        key = (ne, nv, nb, nk)
+        fn = self._folds.get(key)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from . import vrf_jax
+
+        def fold(flat, ed_own, vrf_own, gamma_b, c_b):
+            off = 0
+            m = jnp.int32(FOLD_SENT)
+            if ne:
+                ed_ok = flat[:ne]
+                m = jnp.minimum(m, jnp.min(
+                    jnp.where(ed_ok != 0, FOLD_SENT, ed_own)))
+                off += ne
+            if nv:
+                rows = flat[off:off + nv * 130].reshape(nv, 130)
+                ok = vrf_jax.challenge_ok_device(rows, gamma_b, c_b)
+                m = jnp.minimum(m, jnp.min(
+                    jnp.where(ok, FOLD_SENT, vrf_own)))
+                off += nv * 130
+            beta_part = flat[off:off + nb * 33]
+            off += nb * 33
+            kes_part = flat[off:off + nk]
+            idx = m.astype(jnp.uint32)
+            idx4 = jnp.stack([idx & 0xFF, (idx >> 8) & 0xFF,
+                              (idx >> 16) & 0xFF,
+                              (idx >> 24) & 0xFF]).astype(jnp.uint8)
+            return jnp.concatenate([idx4, kes_part, beta_part])
+
+        # the composite's packed output is consumed here and never read
+        # again — donate it so the fold reuses its buffer
+        fn = jax.jit(fold, donate_argnums=(0,)) if self._donate \
+            else jax.jit(fold)
+        fn = _compile_span_on_first_call(
+            fn, f"window.fold({ne},{nv},{nb},{nk})")
+        self._folds[key] = fn
+        return fn
 
     def _window_choice(self, ne, nv, nb, nk, ed_args, vrf_args,
                        beta_args, kes_args) -> bool:
@@ -550,7 +712,10 @@ class JaxBackend(CryptoBackend):
     def finish_window(self, state):
         """Block on a submit_window dispatch (one transfer); returns
         (ok list aligned with the submitted reqs, {proof: beta} for the
-        requested next-window proofs)."""
+        requested next-window proofs).  For a fold=True submission the
+        first element is a WindowVerdict instead of the boolean list."""
+        if state.get("fold"):
+            return self._finish_window_fold(state)
         out = [False] * state["n"]
         betas: dict = {}
         if state["packed"] is None:
@@ -595,6 +760,39 @@ class JaxBackend(CryptoBackend):
                 for i in owners:
                     out[i] = False
         return out, betas
+
+    def _finish_window_fold(self, state):
+        """Fold-mode drain: one tiny transfer — [first-bad idx | KES job
+        flags | beta rows] — merged with the host-known failures into a
+        WindowVerdict."""
+        from . import vrf_jax
+        from .backend import WindowVerdict
+        n = state["n"]
+        betas: dict = {}
+        bad = state["host_first_bad"]
+        if state["packed"] is None:
+            return WindowVerdict(
+                n, None if bad >= FOLD_SENT else bad), betas
+        with _spans.span("window.drain", cat="device"):
+            flat = np.asarray(state["packed"])      # THE round trip
+        dev_bad = (int(flat[0]) | int(flat[1]) << 8
+                   | int(flat[2]) << 16 | int(flat[3]) << 24)
+        bad = min(bad, dev_bad)
+        off = 4
+        kes_ok = flat[off:off + state["nk"]]
+        off += state["nk"]
+        for key, start, n_jobs, owners, leaf_vk in state["kes_checks"]:
+            path_ok = bool(np.all(kes_ok[start:start + n_jobs])) \
+                if n_jobs else True
+            GLOBAL_PRECOMPUTE_CACHE.kes_put(key, leaf_vk, path_ok)
+            if not path_ok:
+                bad = min(bad, min(owners))
+        if state["beta"] is not None:
+            rows = flat[off:off + state["nb"] * 33].reshape(-1, 33)
+            bs = vrf_jax._finish_betas(rows, state["beta"][0],
+                                       len(state["beta_proofs"]))
+            betas = dict(zip(state["beta_proofs"], bs))
+        return WindowVerdict(n, None if bad >= FOLD_SENT else bad), betas
 
     def verify_kes_batch(self, reqs):
         """KES batch: leaf Ed25519 on the curve kernels + hash path on the

@@ -125,7 +125,10 @@ class PrecomputeCache:
                 continue
             ent = self._c.get(vk, _MISSING)
             if ent is not _MISSING:
-                self._c.move_to_end(vk)
+                try:                    # recency touch is best-effort: a
+                    self._c.move_to_end(vk)   # concurrent eviction (the
+                except KeyError:        # pipelined replay's other thread)
+                    pass                # may have dropped vk already
                 self.hits += 1
                 local[vk] = ent
             else:
@@ -196,7 +199,10 @@ class PrecomputeCache:
         if ent is None:
             self.misses += 1
             return None
-        self._kes.move_to_end(key)
+        try:                        # best-effort recency touch (see
+            self._kes.move_to_end(key)   # assemble: the consumer thread
+        except KeyError:            # may evict concurrently)
+            pass
         self.hits += 1
         return ent
 
@@ -208,10 +214,19 @@ class PrecomputeCache:
 
     # -- plumbing ------------------------------------------------------------
     def _insert(self, od: OrderedDict, key, value) -> None:
+        # every step tolerates a concurrent _insert from the pipelined
+        # replay's other thread (dict ops are GIL-atomic; only the LRU
+        # bookkeeping can observe a key another thread just evicted)
         od[key] = value
-        od.move_to_end(key)
+        try:
+            od.move_to_end(key)
+        except KeyError:
+            pass
         while len(od) > self.max_entries:
-            od.popitem(last=False)
+            try:
+                od.popitem(last=False)
+            except KeyError:
+                break
             self.evictions += 1
 
     def clear(self) -> None:

@@ -298,6 +298,49 @@ def vrf_verify_words_core(Yw, xYw, Gw, signG, rw, cw, sw):
 vrf_verify_words_kernel = jax.jit(vrf_verify_words_core)
 
 
+# challenge preimage prefix bytes (suite || 0x02), a host constant hoisted
+# out of the jitted fold body
+_SUITE2 = np.frombuffer(SUITE + b"\x02", dtype=np.uint8)
+
+
+def challenge_ok_device(rows, gamma_bytes, c_bytes):
+    """Device-side ECVRF challenge verdict from the kernel's (N, 130)
+    output rows: c == SHA512(suite || 0x02 || H || Gamma || U || V)[:16]
+    (vrf_ref._hash_points order), folded with the rows' decompression
+    flags.  Returns (N,) bool.
+
+    This is the device analog of the host loop in `_finish` — with it,
+    the fused window program ships ONE fold scalar instead of 130 bytes
+    per proof (sha512_jax has the transfer arithmetic).
+
+    `gamma_bytes` is (N, 32) uint8 (proof bytes 0:32, the compressed
+    Gamma), `c_bytes` (N, 16) uint8 (proof bytes 32:48) — both
+    host-known inputs; H, U, V stay on device."""
+    from . import sha512_jax as S
+    n = rows.shape[0]
+    prefix = jnp.broadcast_to(jnp.asarray(_SUITE2), (n, 2))
+    msg = jnp.concatenate(
+        [prefix, rows[:, 0:32], gamma_bytes.astype(jnp.uint8),
+         rows[:, 32:96]], axis=1)
+    c_match = S.prefix16_eq(msg, 130, c_bytes)
+    okY = rows[:, 128].astype(bool)
+    okG = rows[:, 129].astype(bool)
+    return c_match & okY & okG
+
+
+def vrf_verify_fold_words_core(Yw, xYw, Gw, signG, rw, cw, sw,
+                               gamma_bytes, c_bytes, valid):
+    """Packed-words verify + on-device challenge fold: (N,) uint8
+    verdicts (valid & challenge & decompression flags) — the
+    transfer-thin verdict form (16 B -> 1 B per 130 B row)."""
+    rows = vrf_verify_words_core(Yw, xYw, Gw, signG, rw, cw, sw)
+    ok = challenge_ok_device(rows, gamma_bytes, c_bytes)
+    return (ok & (valid != 0)).astype(jnp.uint8)
+
+
+vrf_verify_fold_words_kernel = jax.jit(vrf_verify_fold_words_core)
+
+
 @jax.jit
 def gamma8_kernel(yG, signG):
     """[8]Gamma compressed, for batched beta derivation (proof_to_hash).

@@ -30,17 +30,25 @@ not pull in the device stack just by timing themselves.
 
 Disabled recording is near-free: `span()` returns one shared null
 context manager (no allocation, no clock read).
+
+Thread discipline: the pipelined replay runs its host-sequential pass on
+a background producer thread (consensus/pipeline.py), so the recorder
+keeps one open-span stack PER THREAD (a producer's `window.host_seq`
+must never adopt the consumer's `window.drain` as a child just because
+they overlap in wall time).  Completed roots land in one shared,
+lock-guarded list so a drain sees both threads' trees.
 """
 from __future__ import annotations
 
 import sys
+import threading
 import time
 from typing import List, Optional
 
 from ..simharness import runtime as _runtime
 from . import metrics as _metrics
 
-PHASES = ("host-seq", "dispatch", "device", "compile", "sync")
+PHASES = ("host-seq", "dispatch", "device", "compile", "sync", "stall")
 
 
 def monotonic_now() -> float:
@@ -140,10 +148,21 @@ class SpanRecorder:
         self.enabled = enabled
         self.max_roots = max_roots
         self.roots: List[Span] = []
-        self._stack: List[Span] = []
+        self._tls = threading.local()      # per-thread open-span stack
+        self._lock = threading.Lock()      # guards roots/dropped
         self.dropped = 0
         self._drop_counter = _metrics.counter("observe.spans_dropped",
                                               always=True)
+
+    @property
+    def _stack(self) -> List[Span]:
+        """Open-span stack of the CALLING thread: nesting is a per-thread
+        notion — a producer-thread span overlapping a consumer-thread
+        span in wall time is concurrency, not containment."""
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
 
     # -- the public surface ------------------------------------------------
     def span(self, name: str, cat: str = "host-seq", fence: bool = False):
@@ -162,13 +181,15 @@ class SpanRecorder:
     def drain(self) -> List[Span]:
         """Completed root spans since the last drain (open spans stay on
         the stack and attach to a later drain's roots when closed)."""
-        out, self.roots = self.roots, []
+        with self._lock:
+            out, self.roots = self.roots, []
         return out
 
     def clear(self) -> None:
-        self.roots = []
-        self._stack = []
-        self.dropped = 0
+        with self._lock:
+            self.roots = []
+            self._tls = threading.local()
+            self.dropped = 0
 
     # -- recording ---------------------------------------------------------
     def _open(self, name: str, cat: str) -> Span:
@@ -186,22 +207,25 @@ class SpanRecorder:
         sp.t1 = monotonic_now()
         # tolerate out-of-order closes (a generator-held span closed
         # late): pop up to and including sp, re-parenting survivors
-        if sp in self._stack:
-            while self._stack:
-                top = self._stack.pop()
+        stack = self._stack
+        if sp in stack:
+            while stack:
+                top = stack.pop()
                 if top is sp:
                     break
                 if top.t1 is None:
                     top.t1 = sp.t1
                 sp.children.append(top)
-        parent = self._stack[-1] if self._stack else None
+        parent = stack[-1] if stack else None
         if parent is not None:
             parent.children.append(sp)
-        elif len(self.roots) < self.max_roots:
-            self.roots.append(sp)
         else:
-            self.dropped += 1
-            self._drop_counter.inc()
+            with self._lock:
+                if len(self.roots) < self.max_roots:
+                    self.roots.append(sp)
+                else:
+                    self.dropped += 1
+                    self._drop_counter.inc()
 
 
 RECORDER = SpanRecorder()
@@ -222,6 +246,54 @@ def span(name: str, cat: str = "host-seq", fence: bool = False):
 
 def enabled() -> bool:
     return RECORDER.enabled
+
+
+def intervals_of(spans_: List[Span], cat: Optional[str] = None,
+                 name: Optional[str] = None) -> list:
+    """(t0, t1) intervals of every completed span in the forest matching
+    `cat` and/or `name` (None = match all).  Inputs for overlap math —
+    the bench's host-under-device attribution."""
+    out = []
+    for root in spans_:
+        for sp in root.walk():
+            if sp.t1 is None:
+                continue
+            if cat is not None and sp.cat != cat:
+                continue
+            if name is not None and sp.name != name:
+                continue
+            out.append((sp.t0, sp.t1))
+    return out
+
+
+def merge_intervals(intervals: list) -> list:
+    """Union of intervals as a sorted, disjoint list."""
+    merged: list = []
+    for t0, t1 in sorted(intervals):
+        if merged and t0 <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], t1))
+        else:
+            merged.append((t0, t1))
+    return merged
+
+
+def overlap_seconds(a: list, b: list) -> float:
+    """Total seconds where the union of `a` intersects the union of `b`
+    — e.g. host-seq time HIDDEN under in-flight device time.  The two
+    forests' clocks must be comparable (same monotonic_now source)."""
+    a, b = merge_intervals(a), merge_intervals(b)
+    i = j = 0
+    total = 0.0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
 
 
 def phase_totals(spans_: List[Span]) -> dict:

@@ -424,3 +424,87 @@ def test_precompute_counters_live_in_global_registry():
         assert inst.value == before + 1
     finally:
         GLOBAL_PRECOMPUTE_CACHE.hits = before
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: per-thread span stacks + interval/overlap math (the pipelined
+# replay's producer and consumer record concurrently; bench's `overlap`
+# section is computed from these primitives)
+# ---------------------------------------------------------------------------
+
+def test_spans_per_thread_stacks_never_cross_adopt():
+    """A producer-thread span overlapping a consumer-thread span in wall
+    time is concurrency, not containment: each thread keeps its own open
+    stack, completed roots land in the shared list."""
+    import threading
+
+    rec = SpanRecorder(enabled=True)
+    gate_a = threading.Event()
+    gate_b = threading.Event()
+
+    def producer():
+        with rec.span("host_seq", cat="host-seq"):
+            with rec.span("pack", cat="host-seq"):
+                gate_a.set()            # overlap with the consumer span
+                gate_b.wait(5)
+
+    t = threading.Thread(target=producer)
+    t.start()
+    gate_a.wait(5)
+    with rec.span("drain", cat="device"):
+        pass
+    gate_b.set()
+    t.join()
+    roots = rec.drain()
+    by_name = {r.name: r for r in roots}
+    assert set(by_name) == {"host_seq", "drain"}
+    assert [c.name for c in by_name["host_seq"].children] == ["pack"]
+    assert by_name["drain"].children == []      # no cross-thread adoption
+
+
+def test_spans_concurrent_closes_are_recorded_without_loss():
+    """Many threads closing spans concurrently: every root is recorded
+    exactly once (the shared roots list is lock-guarded)."""
+    import threading
+
+    rec = SpanRecorder(enabled=True, max_roots=10_000)
+
+    def worker(k):
+        for i in range(50):
+            with rec.span(f"w{k}.{i}", cat="host-seq"):
+                pass
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    roots = rec.drain()
+    assert len(roots) == 200
+    assert len({r.name for r in roots}) == 200
+    assert rec.dropped == 0
+
+
+def test_interval_and_overlap_math():
+    a = [(0.0, 1.0), (0.5, 2.0), (3.0, 4.0)]
+    assert spans.merge_intervals(a) == [(0.0, 2.0), (3.0, 4.0)]
+    # host [0,2]u[3,4]; device [1.5, 3.5] -> overlap 0.5 + 0.5
+    assert spans.overlap_seconds(a, [(1.5, 3.5)]) == pytest.approx(1.0)
+    assert spans.overlap_seconds([], [(0, 1)]) == 0.0
+    assert spans.overlap_seconds([(0, 1)], [(2, 3)]) == 0.0
+
+
+def test_intervals_of_filters_by_cat_and_name():
+    rec = SpanRecorder(enabled=True)
+    with rec.span("window.host_seq", cat="host-seq"):
+        pass
+    with rec.span("window.drain", cat="device"):
+        pass
+    with rec.span("producer.stall", cat="stall"):
+        pass
+    roots = rec.drain()
+    assert len(spans.intervals_of(roots, name="window.drain")) == 1
+    assert len(spans.intervals_of(roots, cat="stall")) == 1
+    assert len(spans.intervals_of(roots)) == 3
+    assert spans.intervals_of(roots, cat="compile") == []

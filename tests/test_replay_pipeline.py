@@ -201,13 +201,257 @@ def test_pipelined_two_deep_failure_index(chain):
 
 
 @pytest.mark.device
+@pytest.mark.slow
 def test_pipelined_jax_backend_matches(chain):
+    """JaxBackend through the threaded+fold pipeline on a longer chain.
+    slow: tracing this chain's window-composite/fold shapes costs ~3
+    CPU-minutes per process (the persistent cache only skips the XLA
+    compile, not the trace) — tier-1 gates the same path end-to-end via
+    bench --smoke's state-hash parity in test_tools."""
     jax = pytest.importorskip("jax")
     from ouroboros_tpu.crypto.jax_backend import JaxBackend
     ext, blocks, final = chain
-    jb = JaxBackend(min_bucket=16)
+    # XLA-only, no autotune (like bench --smoke): the autotuner would
+    # MEASURE pallas+XLA candidates for every window/fold shape here —
+    # minutes of AOT pallas compile with no extra coverage (kernel
+    # selection has its own tests)
+    jb = JaxBackend(min_bucket=16, use_pallas=False, autotune=False)
     res = replay_blocks_pipelined(ext, blocks, ext.initial_state(),
                                   backend=jb, window=8)
     assert res.all_valid, res.error
     assert (res.final_state.ledger.state_hash()
             == final.ledger.state_hash())
+
+
+# ---------------------------------------------------------------------------
+# Threaded producer/consumer pipeline (ISSUE 8): the submit_window path of
+# replay_blocks_pipelined now runs the host-sequential pass on a background
+# producer thread (consensus/pipeline.py).  Scheduling must not change the
+# outcome, errors must drain oldest-first, and the producer thread must
+# never leak — least of all on error paths where it runs ahead.
+# ---------------------------------------------------------------------------
+
+import threading
+
+from ouroboros_tpu.crypto.backend import WindowVerdict
+from ouroboros_tpu.observe import metrics as _metrics
+
+
+def _producer_threads_alive():
+    return [t for t in threading.enumerate()
+            if t.name == "ouro-replay-producer" and t.is_alive()]
+
+
+def _producer_counters():
+    started = _metrics.counter("pipeline.producers_started",
+                               always=True).value
+    finished = _metrics.counter("pipeline.producers_finished",
+                                always=True).value
+    return started, finished
+
+
+def _tamper(blocks, ix, byte=3):
+    blk = blocks[ix]
+    sig = bytearray(blk.header.get(KES_FIELD))
+    sig[byte] ^= 1
+    out = list(blocks)
+    out[ix] = ProtocolBlock(blk.header.with_fields(**{KES_FIELD:
+                                                      bytes(sig)}),
+                            blk.body)
+    return out
+
+
+class FoldStubBackend(AsyncStubBackend):
+    """AsyncStubBackend speaking the fold=True protocol: finish_window
+    returns a WindowVerdict (first failing request index) instead of the
+    per-proof vector — the CPU model of the device-side verdict fold."""
+
+    supports_window_fold = True
+
+    def __init__(self):
+        super().__init__()
+        self.fold_submissions = 0
+
+    def submit_window(self, reqs, next_beta_proofs=(), fold=False):
+        st = super().submit_window(reqs, next_beta_proofs)
+        st["fold"] = fold
+        if fold:
+            self.fold_submissions += 1
+        return st
+
+    def finish_window(self, state):
+        ok, betas = super().finish_window(state)
+        if not state.get("fold"):
+            return ok, betas
+        first_bad = ok.index(False) if False in ok else None
+        return WindowVerdict(len(ok), first_bad), betas
+
+
+def test_threaded_result_identical_to_sync_driver(chain):
+    """ReplayResult parity, threaded (AsyncStubBackend) vs the
+    synchronous fallback driver (OpensslBackend has no submit_window),
+    over the valid chain, a mid-chain proof tamper, and a truncation —
+    same n_valid, same error presence, same final state hash."""
+    from ouroboros_tpu.crypto.backend import GLOBAL_BETA_CACHE
+    ext, blocks, _final = chain
+    variants = [list(blocks), _tamper(blocks, 9),
+                list(blocks[:7]) + list(blocks[8:])]
+    for blks in variants:
+        GLOBAL_BETA_CACHE.clear()
+        sync = replay_blocks_pipelined(ext, blks, ext.initial_state(),
+                                       backend=BACKEND, window=4)
+        GLOBAL_BETA_CACHE.clear()
+        thr = replay_blocks_pipelined(ext, blks, ext.initial_state(),
+                                      backend=AsyncStubBackend(),
+                                      window=4)
+        assert thr.n_valid == sync.n_valid
+        assert (thr.error is None) == (sync.error is None)
+        if sync.final_state is None:
+            assert thr.final_state is None
+        else:
+            assert (thr.final_state.ledger.state_hash()
+                    == sync.final_state.ledger.state_hash())
+
+
+def test_fold_verdict_path_matches_vector_path(chain):
+    """The fold=True drain (WindowVerdict scalar) must reproduce the
+    vector drain's ReplayResult exactly — valid and tampered."""
+    from ouroboros_tpu.crypto.backend import GLOBAL_BETA_CACHE
+    ext, blocks, _final = chain
+    for blks in (list(blocks), _tamper(blocks, 13), _tamper(blocks, 0)):
+        GLOBAL_BETA_CACHE.clear()
+        vec = replay_blocks_pipelined(ext, blks, ext.initial_state(),
+                                      backend=AsyncStubBackend(),
+                                      window=4)
+        GLOBAL_BETA_CACHE.clear()
+        fb = FoldStubBackend()
+        fold = replay_blocks_pipelined(ext, blks, ext.initial_state(),
+                                       backend=fb, window=4)
+        assert fb.fold_submissions == fb.submitted > 0
+        assert fold.n_valid == vec.n_valid
+        assert (fold.error is None) == (vec.error is None)
+        if vec.final_state is not None:
+            assert (fold.final_state.ledger.state_hash()
+                    == vec.final_state.ledger.state_hash())
+
+
+def test_error_with_producer_ahead_no_leaks(chain):
+    """A proof failure in an early window while the producer has run
+    ahead: the earliest bad block index wins, every optimistically
+    submitted window is still drained (no leaked device work), and the
+    producer thread is joined."""
+    from ouroboros_tpu.crypto.backend import GLOBAL_BETA_CACHE
+    ext, blocks, _final = chain
+    bad_ix = 1                       # first window at window=4
+    tampered = _tamper(blocks, bad_ix)
+    for mk in (AsyncStubBackend, FoldStubBackend):
+        GLOBAL_BETA_CACHE.clear()
+        sb = mk()
+        s0, f0 = _producer_counters()
+        res = replay_blocks_pipelined(ext, tampered, ext.initial_state(),
+                                      backend=sb, window=4)
+        assert not res.all_valid
+        assert res.n_valid == bad_ix
+        assert res.final_state is None
+        # every submitted window was finished — ahead-of-error windows
+        # are discarded via finish_window, not dropped
+        assert sb.submitted == sb.finished > 0
+        s1, f1 = _producer_counters()
+        assert (s1 - s0, f1 - f0) == (1, 1)
+        assert not _producer_threads_alive()
+
+
+def test_producer_crash_reraises_on_caller(chain):
+    """An unexpected exception in the producer (submit machinery broke)
+    re-raises on the caller thread and never leaks the producer."""
+    ext, blocks, _final = chain
+
+    class ExplodingBackend(AsyncStubBackend):
+        def submit_window(self, reqs, next_beta_proofs=()):
+            if self.submitted >= 2:
+                raise RuntimeError("submit machinery broke")
+            return super().submit_window(reqs, next_beta_proofs)
+
+    s0, f0 = _producer_counters()
+    with pytest.raises(RuntimeError, match="submit machinery broke"):
+        replay_blocks_pipelined(ext, blocks, ext.initial_state(),
+                                backend=ExplodingBackend(), window=4)
+    s1, f1 = _producer_counters()
+    assert (s1 - s0, f1 - f0) == (1, 1)
+    assert not _producer_threads_alive()
+
+
+def test_pipeline_sim_model_race_free_at_k16():
+    """The coordination protocol of consensus/pipeline.py — permit gate
+    at the beta-carry depth, oldest-first drain, stop-on-error — modeled
+    1:1 on the simharness and explored under ouro-race with K=16 seeded
+    schedules: no unordered access pair in any schedule (every shared
+    access is transactional), no model failure, and the report is
+    deterministic.  A mid-stream failure variant exercises the stop
+    path, where the producer may be ahead."""
+    from ouroboros_tpu import simharness as sim
+    from ouroboros_tpu.consensus.pipeline import DEPTH
+
+    def make_model(n_windows=6, fail_at=None):
+        async def main():
+            pending = sim.TVar((), label="pipe.pending")
+            submitted = sim.TVar(0, label="pipe.submitted")
+            drained = sim.TVar(0, label="pipe.drained")
+            stop = sim.TVar(False, label="pipe.stop")
+            done = sim.TVar(False, label="pipe.done")
+            order = sim.TVar((), label="pipe.drain-order")
+
+            async def producer():
+                for w in range(n_windows):
+                    def gate(tx):
+                        if not tx.read(stop):
+                            tx.check(tx.read(submitted)
+                                     - tx.read(drained) < DEPTH)
+                        return tx.read(stop)
+                    if await sim.atomically(gate):
+                        break
+                    await sim.yield_()          # the sequential pass
+                    await sim.atomically(lambda tx, w=w: (
+                        tx.write(pending, tx.read(pending) + (w,)),
+                        tx.write(submitted, tx.read(submitted) + 1)))
+                await sim.atomically(lambda tx: tx.write(done, True))
+
+            async def consumer():
+                while True:
+                    def pop(tx):
+                        p = tx.read(pending)
+                        if p:
+                            tx.write(pending, p[1:])
+                            return p[0]
+                        tx.check(tx.read(done))
+                        return None
+                    w = await sim.atomically(pop)
+                    if w is None:
+                        break
+                    await sim.yield_()          # the blocking drain
+                    err = fail_at is not None and w == fail_at
+                    await sim.atomically(lambda tx, w=w, err=err: (
+                        tx.write(order, tx.read(order) + (w,)),
+                        tx.write(drained, tx.read(drained) + 1),
+                        err and tx.write(stop, True)))
+                    if err:
+                        break
+
+            p = sim.spawn(producer(), label="pipe-producer")
+            c = sim.spawn(consumer(), label="pipe-consumer")
+            await p.wait()
+            await c.wait()
+            got = order.value
+            want = tuple(range(len(got)))
+            assert got == want, f"drain order broke: {got}"
+            if fail_at is not None and len(got):
+                assert got[-1] <= fail_at + (DEPTH - 1)
+        return main
+
+    for fail_at in (None, 2):
+        rep = sim.explore_races(make_model(fail_at=fail_at), k=16, seed=0)
+        assert not rep.failures, rep.render()
+        assert not rep.found, rep.render()
+        rep2 = sim.explore_races(make_model(fail_at=fail_at), k=16,
+                                 seed=0)
+        assert rep.render() == rep2.render()    # deterministic

@@ -115,15 +115,22 @@ def test_shelley_replay_backend_parity(shelley_db):
 def test_bench_smoke_parity_gate():
     """`bench --smoke` in-process: the tier-1 guard that keeps the
     replay hot path honest between bench rounds — tiny synth chain, one
-    JAX replay vs the CPU baseline (state-hash parity + cross-window
-    key reuse) and a cold+warm corrupted mixed batch (verdict parity +
-    zero warm-path fill dispatches).  No timing assertions (that is the
-    real bench's job on real hardware)."""
+    JAX replay (the threaded producer/consumer pipeline with the device
+    verdict fold) vs the CPU baseline (state-hash parity + cross-window
+    key reuse), a cold+warm corrupted mixed batch (verdict parity in
+    both vector and fold form + zero warm-path fill dispatches), the
+    producer-thread shutdown check, the overlap-attribution plumbing
+    probe, and the fenced vrf-spread gate."""
     pytest.importorskip("jax")
     sys.path.insert(0, REPO)
     import bench
     res = bench.smoke()
     assert res["state_hash_parity"] and res["verdict_parity"]
+    assert res["fold_verdict_parity"]
+    assert res["pipelined_producers_run"] >= 1
+    assert res["producer_threads_leaked"] == 0
+    assert res["overlap_probe"]["host_seq_secs"] > 0
+    assert res["vrf_spread_probe"]["ok"]
     assert res["warm_device_fills"] == 0 and res["warm_kes_jobs"] == 0
     assert res["blocks"] == 8
 
