@@ -541,6 +541,8 @@ def smoke(blocks: int = 8, window: int = 8):
         snapshot_ok, disabled_writes, disabled_spans = \
             _smoke_observe(jb, parity_reqs)
         vrf_probe = _smoke_vrf_spread(jb)
+        scrape_ok, scrape_leaked, scrape_q = _smoke_scrape()
+        perfgate_ok, _perfgate_verdict = _smoke_perfgate()
         result = {"metric": "bench_smoke", "value": 1.0,
                   "blocks": len(blocks_l), "proofs": n_proofs,
                   "state_hash_parity": bool(hash_ok),
@@ -556,6 +558,10 @@ def smoke(blocks: int = 8, window: int = 8):
                   "observe_snapshot_parses": bool(snapshot_ok),
                   "disabled_registry_writes": int(disabled_writes),
                   "disabled_spans_recorded": int(disabled_spans),
+                  "scrape_roundtrip": bool(scrape_ok),
+                  "scrape_threads_leaked": int(scrape_leaked),
+                  "scrape_submit_drain_quantiles": scrape_q,
+                  "perfgate_ok": bool(perfgate_ok),
                   "precompute": GLOBAL_PRECOMPUTE_CACHE.stats()}
         if not (hash_ok and verdict_ok and fold_ok
                 and producers_run >= 1 and leaked == 0
@@ -564,7 +570,9 @@ def smoke(blocks: int = 8, window: int = 8):
                 and warm_fills == 0
                 and warm_jobs == 0 and replay_fills <= 3
                 and snapshot_ok and disabled_writes == 0
-                and disabled_spans == 0):
+                and disabled_spans == 0
+                and scrape_ok and scrape_leaked == 0
+                and perfgate_ok):
             result["value"] = 0.0
             print(json.dumps(result))
             raise SystemExit(f"bench --smoke parity failure: {result}")
@@ -674,6 +682,64 @@ def _smoke_observe(jb, probe_reqs):
     finally:
         reg.enabled, rec.enabled = was_reg, was_rec
     return snapshot_ok, disabled_writes, disabled_spans
+
+
+def _smoke_scrape():
+    """Scrape-endpoint smoke (ISSUE 9): serve the process registry over
+    the project's own snocket/SDU transport inside a deterministic sim,
+    scrape it, and re-derive latency quantiles from the exposition.  The
+    pipelined replay that just ran populated `pipeline.submit_drain_secs`
+    — the scraped p50/p95/p99 must come back finite and ordered — and
+    the sim must wind down with ZERO leaked threads (the clean-shutdown
+    contract of ScrapeServer/PeriodicEmitter).
+
+    Returns (ok, leaked_threads, quantiles)."""
+    from ouroboros_tpu import simharness as sim
+    from ouroboros_tpu.network.snocket import SimSnocket
+    from ouroboros_tpu.observe import export
+    from ouroboros_tpu.observe.scrape import (
+        PeriodicEmitter, ScrapeServer, scrape,
+    )
+
+    emitted = []
+
+    async def main():
+        sn = SimSnocket()
+        srv = await ScrapeServer(sn, "metrics").start()
+        em = await PeriodicEmitter(1.0, emitted.append).start()
+        text = await scrape(sn, "metrics")
+        await sim.sleep(2.5)
+        await srv.stop()
+        await em.stop()
+        return text
+
+    text, trace = sim.run_trace(main())
+    leaked = len(sim.leaked_threads(trace))
+    try:
+        parsed = export.parse_prometheus_text(text)
+        q = export.prom_histogram_quantiles(
+            parsed, "ouro_pipeline_submit_drain_secs")
+        ok = (parsed.get("ouro_pipeline_submit_drain_secs_count", 0) > 0
+              and 0 < q["p50"] <= q["p95"] <= q["p99"]
+              and len(emitted) >= 2)
+    except Exception as e:
+        log(f"scrape smoke failed to parse: {e!r}")
+        ok, q = False, {}
+    return ok, leaked, q
+
+
+def _smoke_perfgate():
+    """Run the trajectory gate over the committed BENCH_r*.json rounds —
+    tier-1 fails the moment a regressed round is recorded (the prose
+    trajectory in ROADMAP becomes an enforced gate)."""
+    from tools.perfgate import check_trajectory
+    paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    if not paths:
+        return True, {"checks": [], "note": "no recorded rounds"}
+    verdict = check_trajectory(paths)
+    if not verdict["ok"]:
+        log(f"perfgate FAILED: {json.dumps(verdict['checks'])}")
+    return verdict["ok"], verdict
 
 
 def _clear_beta_cache():

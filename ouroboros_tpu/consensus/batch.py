@@ -267,4 +267,4 @@ def replay_blocks_pipelined(
 
     from .pipeline import replay_threaded
     return replay_threaded(ext_rules, blocks, ext_state, backend,
-                           window=window)
+                           window=window)  # total inferred from len()

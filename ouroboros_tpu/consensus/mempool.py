@@ -22,8 +22,19 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
 
 from ..chain.block import Point
+from ..observe import metrics as _metrics
+from ..observe.spans import monotonic_now as _now
 from ..utils import cbor
 from .ledger import LedgerError, LedgerRules
+
+# arrival instrumentation (ISSUE 9): a caught-up node's mempool sees a
+# firehose of batch-of-1 tx admissions — these three histograms make the
+# batch-of-1 vs batch-of-N trade measurable BEFORE the adaptive batching
+# service exists (ROADMAP item 3).  Handles pre-bound (OBS002); sizes
+# and latencies are timing/traffic-shaped, so all three are unstable.
+_ARRIVAL_TXS = _metrics.histogram("mempool.arrival_txs", stable=False)
+_ADMIT_SECS = _metrics.latency_histogram("mempool.admit_secs")
+_INTERARRIVAL = _metrics.latency_histogram("mempool.interarrival_secs")
 
 
 @dataclass(frozen=True)
@@ -86,6 +97,7 @@ class Mempool:
         self.capacity_bytes = capacity_bytes
         self.backend = backend
         self._entries: list[MempoolEntry] = []
+        self._last_arrival: Optional[float] = None
         self._next_ticket = 1
         base, tip = get_ledger()
         self._base_state = base          # ledger state at tip, no mempool txs
@@ -122,6 +134,13 @@ class Mempool:
         keeps rejecting-on-validity) when capacity is reached, like
         tryAddTxs's MempoolCapacityBytesOverride behaviour.
         """
+        observing = _metrics.enabled()
+        if observing:
+            t0 = _now()
+            _ARRIVAL_TXS.observe(len(txs))
+            if self._last_arrival is not None:
+                _INTERARRIVAL.observe(t0 - self._last_arrival)
+            self._last_arrival = t0
         added, rejected = [], []
         for tx in txs:
             size = _tx_size(tx)
@@ -143,6 +162,8 @@ class Mempool:
             added.append(tx.txid)
         if added:
             self._bump()
+        if observing:
+            _ADMIT_SECS.observe(_now() - t0)
         return added, rejected
 
     def remove_txs(self, txids: Sequence[bytes]) -> None:

@@ -66,6 +66,7 @@ from collections import deque
 from typing import Any, Optional
 
 from ..crypto.backend import GLOBAL_BETA_CACHE, WindowVerdict
+from ..observe import flight as _flight
 from ..observe import metrics as _metrics
 from ..observe import spans as _spans
 from .header_validation import HeaderError
@@ -83,6 +84,100 @@ _FINISHED = _metrics.counter("pipeline.producers_finished", always=True)
 # observational: windows through the pipeline / producer permit stalls
 _WINDOWS = _metrics.counter("pipeline.windows")
 _STALLS = _metrics.counter("pipeline.producer_stalls")
+# queue-latency instrumentation (ISSUE 9): submit→drain covers the full
+# async residence of a window — dispatch queue + device + transfer —
+# the quantity the adaptive batching service will trade off against
+# coalescing gain.  Handles pre-bound here (OBS002): observe() is two
+# hot-loop calls per window.
+_SUBMIT_DRAIN = _metrics.latency_histogram("pipeline.submit_drain_secs")
+_WINDOW_BLOCKS = _metrics.histogram("pipeline.window_blocks")
+
+# replay progress gauges (rendered live by tools/obsreport.py --live via
+# the scrape endpoint).  blocks_done / windows_in_flight / total are
+# deterministic end-state for a fixed workload (stable); rate/ETA/
+# hidden-fraction are measured seconds (unstable).
+_P_BLOCKS = _metrics.gauge("replay.progress.blocks_done")
+_P_TOTAL = _metrics.gauge("replay.progress.total_blocks")
+_P_INFLIGHT = _metrics.gauge("replay.progress.windows_in_flight")
+_P_RATE = _metrics.gauge("replay.progress.blocks_per_sec", stable=False)
+_P_ETA = _metrics.gauge("replay.progress.eta_secs", stable=False)
+_P_HIDDEN = _metrics.gauge("replay.progress.hidden_frac", stable=False)
+
+
+class ProgressTracker:
+    """Online progress/overlap accounting for one streaming replay,
+    published through the registry after every drained window.
+
+    Exactness without history: hidden host-seq time is the measure of
+    {host sequential pass active} ∩ {≥1 window in flight}.  Both are
+    on/off signals with O(1) transitions (host edges from the producer,
+    in-flight edges from submit/drain), so the intersection accumulates
+    in a scalar — no interval lists to keep, which matters at
+    million-block scale.  ETA uses the blocks/sec observed so far;
+    total_blocks is optional (an unbounded stream has progress but no
+    ETA)."""
+
+    __slots__ = ("t0", "total", "blocks", "host_secs", "hidden_secs",
+                 "_lock", "_inflight", "_host_since", "_both_since")
+
+    def __init__(self, total_blocks: Optional[int] = None):
+        self.t0 = _spans.monotonic_now()
+        self.total = total_blocks
+        self.blocks = 0
+        self.host_secs = 0.0
+        self.hidden_secs = 0.0
+        self._lock = threading.Lock()
+        self._inflight = 0
+        self._host_since: Optional[float] = None
+        self._both_since: Optional[float] = None
+        _P_TOTAL.set(total_blocks if total_blocks is not None else 0)
+        _P_BLOCKS.set(0)
+        _P_INFLIGHT.set(0)
+
+    # -- producer edges ------------------------------------------------------
+    def host_begin(self) -> None:
+        now = _spans.monotonic_now()
+        with self._lock:
+            self._host_since = now
+            if self._inflight:
+                self._both_since = now
+
+    def host_end(self) -> None:
+        now = _spans.monotonic_now()
+        with self._lock:
+            if self._host_since is not None:
+                self.host_secs += now - self._host_since
+                self._host_since = None
+            if self._both_since is not None:
+                self.hidden_secs += now - self._both_since
+                self._both_since = None
+
+    # -- consumer edges ------------------------------------------------------
+    def window_submitted(self) -> None:
+        now = _spans.monotonic_now()
+        with self._lock:
+            self._inflight += 1
+            if self._inflight == 1 and self._host_since is not None:
+                self._both_since = now
+
+    def window_drained(self, n_blocks: int) -> None:
+        now = _spans.monotonic_now()
+        with self._lock:
+            self._inflight -= 1
+            if self._inflight == 0 and self._both_since is not None:
+                self.hidden_secs += now - self._both_since
+                self._both_since = None
+            self.blocks += n_blocks
+            blocks, inflight = self.blocks, self._inflight
+            host, hidden = self.host_secs, self.hidden_secs
+        elapsed = now - self.t0
+        rate = blocks / elapsed if elapsed > 0 else 0.0
+        _P_BLOCKS.set(blocks)
+        _P_INFLIGHT.set(inflight)
+        _P_RATE.set(round(rate, 3))
+        if self.total and rate > 0:
+            _P_ETA.set(round(max(0, self.total - blocks) / rate, 3))
+        _P_HIDDEN.set(round(hidden / host, 4) if host > 0 else 0.0)
 
 
 class _Shared:
@@ -91,11 +186,14 @@ class _Shared:
     setting ``done``."""
 
     __slots__ = ("cond", "pending", "submitted", "drained", "stop",
-                 "done", "crash", "seq_error", "seq_done", "final_state")
+                 "done", "crash", "seq_error", "seq_done", "final_state",
+                 "progress")
 
     def __init__(self):
         self.cond = threading.Condition()
-        self.pending: deque = deque()   # (start, sub, reqs, owner, n_seq)
+        # (start, sub, reqs, owner, n_seq, t_submit)
+        self.pending: deque = deque()
+        self.progress: Optional[ProgressTracker] = None
         self.submitted = 0
         self.drained = 0
         self.stop = False               # consumer: error seen, stop producing
@@ -153,6 +251,9 @@ def _produce(shared: _Shared, ext_rules, block_iter, ext_state, backend,
             owner: list[int] = []
             seq_error: Optional[Exception] = None
             n_seq_w = 0
+            progress = shared.progress
+            if progress is not None:
+                progress.host_begin()
             with _spans.span("window.host_seq", cat="host-seq"):
                 for i, b in enumerate(blk_window):
                     try:
@@ -170,6 +271,8 @@ def _produce(shared: _Shared, ext_rules, block_iter, ext_state, backend,
                     reqs.extend(rs)
                     owner.extend([i] * len(rs))
                     n_seq_w += 1
+            if progress is not None:
+                progress.host_end()
             # carry betas for the window TWO ahead (ahead[1] after the
             # pop): the consumer installs them at drain time, which the
             # permit above orders before that window's sequential pass
@@ -180,9 +283,13 @@ def _produce(shared: _Shared, ext_rules, block_iter, ext_state, backend,
             sub = (submit(reqs, next_proofs, fold=True) if fold
                    else submit(reqs, next_proofs))
             _WINDOWS.inc()
+            _WINDOW_BLOCKS.observe(n_seq_w)
+            if progress is not None:
+                progress.window_submitted()
             with shared.cond:
                 shared.pending.append(
-                    (shared.seq_done, sub, reqs, owner, n_seq_w))
+                    (shared.seq_done, sub, reqs, owner, n_seq_w,
+                     _spans.monotonic_now()))
                 shared.submitted += 1
                 shared.seq_done += n_seq_w
                 shared.cond.notify_all()
@@ -202,8 +309,17 @@ def _drain(backend, entry) -> tuple:
     """Finish one window's device call; install its carried betas.
     Returns (error, n_valid): error None when every proof held, else
     n_valid is the global index of the first bad block."""
-    start, sub, reqs, owner, n_seq_w = entry
-    ok, betas = backend.finish_window(sub)
+    start, sub, reqs, owner, n_seq_w, t_submit = entry
+    # named distinctly from jax_backend's inner "window.drain" span:
+    # bench._rep_overlap pairs submits and drains positionally by name,
+    # and a second same-named interval per drain would break the zip.
+    # This outer span exists for EVERY async backend (the flight
+    # recorder must show drains even on stub/CPU backends); phase
+    # totals stay correct because self-time attribution subtracts the
+    # nested inner span.
+    with _spans.span("pipeline.drain", cat="device"):
+        ok, betas = backend.finish_window(sub)
+    _SUBMIT_DRAIN.observe(_spans.monotonic_now() - t_submit)
     if betas:
         GLOBAL_BETA_CACHE.store_many(betas.keys(), betas.values())
     if isinstance(ok, WindowVerdict):
@@ -226,14 +342,22 @@ def _drain(backend, entry) -> tuple:
 
 
 def replay_threaded(ext_rules, blocks, ext_state, backend,
-                    window: int = 512):
+                    window: int = 512,
+                    total_blocks: Optional[int] = None):
     """Run the producer/consumer pipeline to completion; returns the
     same ReplayResult the synchronous driver would (batch.py re-exports
-    this as the submit_window path of replay_blocks_pipelined)."""
+    this as the submit_window path of replay_blocks_pipelined).
+
+    `total_blocks` (len(blocks) when the caller knows it) feeds the
+    progress tracker's ETA; a streaming replay without it still reports
+    blocks/sec, windows in flight and the hidden fraction."""
     from .batch import ReplayResult
 
+    if total_blocks is None and hasattr(blocks, "__len__"):
+        total_blocks = len(blocks)
     fold = bool(getattr(backend, "supports_window_fold", False))
     shared = _Shared()
+    shared.progress = ProgressTracker(total_blocks)
     t = threading.Thread(
         target=_run_producer,
         args=(shared, ext_rules, iter(blocks), ext_state, backend,
@@ -255,6 +379,7 @@ def replay_threaded(ext_rules, blocks, ext_state, backend,
             with shared.cond:
                 shared.drained += 1
                 shared.cond.notify_all()
+            shared.progress.window_drained(entry[4])
             if err is not None:
                 error, n_ok = err, n
                 break
@@ -271,8 +396,16 @@ def replay_threaded(ext_rules, blocks, ext_state, backend,
             backend.finish_window(entry[1])
         shared.pending.clear()
     if shared.crash is not None:
+        # unhandled producer error: the flight ring holds the last
+        # spans/metric deltas before the crash — dump before re-raising
+        _flight.FLIGHT.dump_on_failure(
+            f"replay producer crash: {shared.crash!r}")
         raise shared.crash
     if error is not None:
+        # ReplayResult failure (first error wins): a crash-proof record
+        # of the moments before the bad window, for offline triage
+        _flight.FLIGHT.dump_on_failure(
+            f"replay failed at block {n_ok}: {error}")
         return ReplayResult(None, n_ok, error)
     if shared.seq_error is not None:
         # the valid prefix (incl. the drained proofs) is fully verified:
@@ -280,6 +413,12 @@ def replay_threaded(ext_rules, blocks, ext_state, backend,
         resume = (shared.final_state
                   if isinstance(shared.seq_error, OutsideForecastRange)
                   else None)
+        if resume is None:
+            # genuine sequential validation failure (retry-later horizon
+            # waits are normal operation, not flight-dump material)
+            _flight.FLIGHT.dump_on_failure(
+                f"replay failed at block {shared.seq_done}: "
+                f"{shared.seq_error}")
         return ReplayResult(resume, shared.seq_done, shared.seq_error)
     return ReplayResult(shared.final_state, shared.seq_done, None)
 

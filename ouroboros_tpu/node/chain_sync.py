@@ -23,6 +23,8 @@ from ..chain.block import Point, point_of
 from ..chain.fragment import AnchoredFragment
 from ..consensus.batch import validate_headers_batched
 from ..consensus.header_validation import HeaderState, HeaderStateHistory
+from ..observe import metrics as _metrics
+from ..observe.spans import monotonic_now as _mono_now
 from ..network.protocols.chainsync import (
     MsgAwaitReply, MsgFindIntersect, MsgIntersectFound, MsgIntersectNotFound,
     MsgRequestNext, MsgRollBackward, MsgRollForward,
@@ -33,6 +35,15 @@ from .watchdog import collect_with_limit, recv_with_limit
 # Fibonacci-ish offsets for intersection points, like the reference's
 # chainSyncClient headerPoints (Client.hs mkPoints)
 _OFFSETS = (0, 1, 2, 3, 5, 8, 13, 21, 34, 55, 89, 144)
+
+# header-arrival instrumentation (ISSUE 9): while syncing the window
+# fills to `window` headers per flush; caught up it degrades to
+# batch-of-1 — the exact distribution the adaptive batching service
+# (ROADMAP item 3) needs to see live.  Handles pre-bound (OBS002);
+# virtual-time gaps under sim, wall gaps in production (unstable).
+_ARRIVAL_GAP = _metrics.latency_histogram("chainsync.arrival_gap_secs")
+_FLUSH_HEADERS = _metrics.histogram("chainsync.flush_headers",
+                                    stable=False)
 
 
 def pipeline_decision(outstanding: int, low: int, high: int,
@@ -124,6 +135,7 @@ async def chain_sync_client(session, kernel, candidate: CandidateState,
         forecast-horizon waiting, Client.hs:~740-790)."""
         if not buffered:
             return
+        _FLUSH_HEADERS.observe(len(buffered))
         from ouroboros_tpu.consensus.ledger import OutsideForecastRange
         res = validate_headers_batched(
             protocol, buffered, history.current,
@@ -150,6 +162,7 @@ async def chain_sync_client(session, kernel, candidate: CandidateState,
                                    f"{res.error}")
 
     horizon_stalled = [False]
+    last_arrival = [None]        # roll-forward inter-arrival gap state
     # watermark pipelining (Protocol/ChainSync/PipelineDecision.hs
     # low/high mark): while BEHIND the server tip the pipeline fills to
     # the high mark (`window`); once caught up new requests only refill
@@ -190,6 +203,11 @@ async def chain_sync_client(session, kernel, candidate: CandidateState,
             flush()
             continue
         if isinstance(msg, MsgRollForward):
+            if _metrics.enabled():
+                now = _mono_now()
+                if last_arrival[0] is not None:
+                    _ARRIVAL_GAP.observe(now - last_arrival[0])
+                last_arrival[0] = now
             buffered.append(msg.header)
             _note_tip(msg.tip)
             if len(buffered) >= window:

@@ -14,6 +14,12 @@ Three parts, one seam (ISSUE 7):
   and the typed-tracer-events -> JSONL bridge.
 - `adapter`: NodeTracers -> metrics (typed protocol events count without
   string matching).
+- `flight`: the always-on flight recorder — a bounded ring of recent
+  spans/events/metric deltas, dumped as chrome-trace + JSONL on failure
+  (ISSUE 9).
+- `scrape` (imported on demand — it pulls the network stack): the live
+  Prometheus scrape endpoint + periodic emitter over the project's own
+  snocket/SDU transport.
 
 Defaults: metric writes are ON (an enabled counter bump is one flag
 read plus an int add) and span recording is OFF (spans allocate and
@@ -27,17 +33,23 @@ observation that the flag may drop.
 """
 from __future__ import annotations
 
-from . import adapter, export, metrics, spans
+from . import adapter, export, flight, metrics, spans
 from .adapter import counting_node_tracers, metrics_node_tracers
+from .flight import FLIGHT, FlightRecorder
 from .metrics import REGISTRY, Counter, Gauge, Histogram, MetricsRegistry
 from .spans import RECORDER, Span, SpanRecorder, phase_totals, span
 
+# NOTE: observe.scrape is deliberately NOT imported here — it pulls in
+# the network stack (snocket/mux), which itself imports observe.metrics;
+# consumers `from ouroboros_tpu.observe import scrape` on demand.
+
 __all__ = [
+    "FLIGHT", "FlightRecorder",
     "REGISTRY", "RECORDER", "Counter", "Gauge", "Histogram",
     "MetricsRegistry", "Span", "SpanRecorder",
     "adapter", "counting_node_tracers", "disable", "enable", "enabled",
-    "export", "metrics", "metrics_node_tracers", "phase_totals", "span",
-    "spans",
+    "export", "flight", "metrics", "metrics_node_tracers", "phase_totals",
+    "span", "spans",
 ]
 
 

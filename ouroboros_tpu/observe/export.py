@@ -22,7 +22,7 @@ import json
 from typing import Iterable, List
 
 from ..utils.tracer import Tracer
-from .metrics import Histogram, MetricsRegistry
+from .metrics import Histogram, MetricsRegistry, quantile_from_buckets
 from .spans import Span
 
 PROM_PREFIX = "ouro_"
@@ -85,6 +85,44 @@ def parse_prometheus_text(text: str) -> dict:
             raise ValueError(f"unparseable exposition line: {line!r}") \
                 from e
     return out
+
+
+def prom_histograms(parsed: dict) -> dict:
+    """Histogram base names present in a parsed exposition: every metric
+    with a `<name>_count` sample and at least one `<name>_bucket{le=..}`
+    sample."""
+    out = []
+    for key in parsed:
+        if key.endswith("_count"):
+            base = key[:-len("_count")]
+            if any(k.startswith(base + '_bucket{le="') for k in parsed):
+                out.append(base)
+    return {b: parsed[b + "_count"] for b in sorted(out)}
+
+
+def prom_histogram_quantiles(parsed: dict, base: str,
+                             qs=(0.50, 0.95, 0.99)) -> dict:
+    """Deterministic quantiles recomputed from a SCRAPED exposition —
+    the consumer-side mirror of Histogram.quantiles(), so a remote
+    scraper (tools/obsreport.py --live, the acceptance test) extracts
+    the same p50/p95/p99 the process would report locally.  `base` is
+    the mangled metric name (e.g. "ouro_pipeline_submit_drain_secs")."""
+    pre = base + '_bucket{le="'
+    pts = []
+    for key, v in parsed.items():
+        if key.startswith(pre):
+            le = key[len(pre):-2]
+            if le != "+Inf":
+                pts.append((float(le), v))
+    pts.sort()
+    edges = tuple(p[0] for p in pts)
+    counts, prev = [], 0.0
+    for _, cum in pts:                     # cumulative -> per-bucket
+        counts.append(cum - prev)
+        prev = cum
+    counts.append(parsed.get(base + "_count", prev) - prev)  # overflow
+    return {f"p{round(q * 100)}": quantile_from_buckets(edges, counts, q)
+            for q in qs}
 
 
 # --- chrome://tracing -------------------------------------------------------

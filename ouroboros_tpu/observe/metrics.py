@@ -57,13 +57,16 @@ class Counter:
         self._reg = reg
 
     def inc(self, n: int = 1) -> None:
+        reg = self._reg
         if self.always:
             self.value += n
-            return
-        reg = self._reg
-        if reg is not None and reg.enabled:
+        elif reg is not None and reg.enabled:
             self.value += n
             reg.data_writes += 1
+        else:
+            return
+        if reg is not None and reg.flight is not None:
+            reg.flight.metric(self.name, "inc", n)
 
     def snapshot_value(self):
         return self.value
@@ -84,13 +87,16 @@ class Gauge:
         self._reg = reg
 
     def set(self, v) -> None:
+        reg = self._reg
         if self.always:
             self.value = v
-            return
-        reg = self._reg
-        if reg is not None and reg.enabled:
+        elif reg is not None and reg.enabled:
             self.value = v
             reg.data_writes += 1
+        else:
+            return
+        if reg is not None and reg.flight is not None:
+            reg.flight.metric(self.name, "set", v)
 
     def snapshot_value(self):
         return self.value
@@ -101,11 +107,44 @@ class Gauge:
 DEFAULT_BUCKETS: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256,
                                       512, 1024, 2048, 4096)
 
+# fixed log-spaced latency bucket edges: 1µs doubling up to ~134s.  ONE
+# shared vocabulary for every duration histogram (queue waits, span
+# phases, submit→drain, arrival gaps) so quantiles from any two
+# instruments — or two runs — are comparable bucket for bucket, and the
+# exposition stays byte-stable for a fixed workload.
+LATENCY_BUCKETS: Tuple[float, ...] = tuple(1e-6 * 2 ** i for i in range(28))
+
+
+def quantile_from_buckets(buckets: Tuple[float, ...], counts: List,
+                          q: float) -> float:
+    """Deterministic quantile from per-bucket counts (len(counts) ==
+    len(buckets) + 1, the final cell being the +inf overflow).
+
+    rank = q * total observations; the answer interpolates linearly
+    inside the bucket containing that rank ([0, b0] for the first, the
+    top edge for overflow — an unbounded bucket cannot be interpolated).
+    Pure integer/float arithmetic on the counts: two histograms with
+    identical counts yield byte-identical quantiles regardless of
+    observation or creation order."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts[:-1]):
+        prev, cum = cum, cum + c
+        if c and cum >= rank:
+            lo = buckets[i - 1] if i else 0.0
+            hi = buckets[i]
+            return round(lo + (hi - lo) * (rank - prev) / c, 9)
+    return float(buckets[-1]) if buckets else 0.0
+
 
 class Histogram:
     """Fixed-bucket histogram (cumulative counts on export, per the
     Prometheus convention; stored per-bucket so observe() is one index
-    update)."""
+    update).  `buckets=LATENCY_BUCKETS` makes it the log-bucket latency
+    form with deterministic p50/p95/p99 via `quantiles()`."""
 
     kind = "histogram"
     __slots__ = ("name", "buckets", "counts", "total", "count", "always",
@@ -135,13 +174,28 @@ class Histogram:
         self.count += 1
 
     def observe(self, v) -> None:
+        reg = self._reg
         if self.always:
             self._record(v)
-            return
-        reg = self._reg
-        if reg is not None and reg.enabled:
+        elif reg is not None and reg.enabled:
             self._record(v)
             reg.data_writes += 1
+        else:
+            return
+        if reg is not None and reg.flight is not None:
+            reg.flight.metric(self.name, "observe", v)
+
+    def quantile(self, q: float) -> float:
+        """Deterministic q-quantile (0 < q < 1) from the bucket counts —
+        see quantile_from_buckets.  p50/p95/p99 of a latency histogram
+        are pure functions of the observation multiset."""
+        return quantile_from_buckets(self.buckets, self.counts, q)
+
+    def quantiles(self) -> dict:
+        """The {p50, p95, p99} triple every latency consumer wants."""
+        return {"p50": self.quantile(0.50),
+                "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99)}
 
     def snapshot_value(self):
         # integers only (total may be float when observing floats; round
@@ -161,6 +215,7 @@ class MetricsRegistry:
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
         self.data_writes = 0          # gated writes that landed (probe)
+        self.flight = None            # armed FlightRecorder (observe/flight)
         self._instruments: Dict[str, object] = {}
 
     # -- creation (idempotent by name) ----------------------------------
@@ -254,6 +309,16 @@ def histogram(name: str, buckets: Iterable[float] = DEFAULT_BUCKETS,
               always: bool = False, stable: bool = True) -> Histogram:
     return REGISTRY.histogram(name, buckets=buckets, always=always,
                               stable=stable)
+
+
+def latency_histogram(name: str) -> Histogram:
+    """A duration histogram on the shared LATENCY_BUCKETS vocabulary.
+    Measured seconds vary run to run, so latency instruments are always
+    `stable=False` — exported live (scrape/Prometheus) but excluded from
+    the deterministic snapshot bench embeds.  Bind the handle ONCE at
+    module/init scope: `observe()` through a fresh registry lookup on a
+    hot path is the OBS002 lint."""
+    return REGISTRY.histogram(name, buckets=LATENCY_BUCKETS, stable=False)
 
 
 def enabled() -> bool:

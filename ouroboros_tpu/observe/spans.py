@@ -151,8 +151,21 @@ class SpanRecorder:
         self._tls = threading.local()      # per-thread open-span stack
         self._lock = threading.Lock()      # guards roots/dropped
         self.dropped = 0
+        self.flight = None                 # armed FlightRecorder
         self._drop_counter = _metrics.counter("observe.spans_dropped",
                                               always=True)
+        # per-phase duration histograms, bound lazily ONCE per category
+        # (a span close must not pay a registry lookup): every close
+        # feeds `latency.phase.<cat>`, so phase p50/p95/p99 are live on
+        # the scrape endpoint while a replay runs
+        self._phase_hist: dict = {}
+
+    def _hist_for(self, cat: str):
+        h = self._phase_hist.get(cat)
+        if h is None:
+            h = _metrics.latency_histogram(f"latency.phase.{cat}")
+            self._phase_hist[cat] = h
+        return h
 
     @property
     def _stack(self) -> List[Span]:
@@ -205,6 +218,9 @@ class SpanRecorder:
             # parent/root and double-count it in phase_totals
             return
         sp.t1 = monotonic_now()
+        fl = self.flight
+        if fl is not None:
+            fl.span(sp)
         # tolerate out-of-order closes (a generator-held span closed
         # late): pop up to and including sp, re-parenting survivors
         stack = self._stack
@@ -217,6 +233,13 @@ class SpanRecorder:
                     top.t1 = sp.t1
                 sp.children.append(top)
         parent = stack[-1] if stack else None
+        # phase-latency feed: one sample per contiguous same-category
+        # episode — a span nested under a SAME-cat parent (JaxBackend's
+        # "window.drain" inside the pipeline's "pipeline.drain", both
+        # device) is the same wait seen twice, and observing both would
+        # double the histogram count and skew the quantiles
+        if parent is None or parent.cat != sp.cat:
+            self._hist_for(sp.cat).observe(sp.t1 - sp.t0)
         if parent is not None:
             parent.children.append(sp)
         else:

@@ -16,6 +16,9 @@ from ..observe import spans as _spans
 
 WINDOW_AXIS = "window"   # the header-window (proof-batch) axis
 
+# pre-bound (OBS002): log_compile_time is cold, but the handle is static
+_LAST_COMPILE = _metrics.gauge("parallel.last_compile_secs", stable=False)
+
 
 def enable_compile_cache(cache_dir: Optional[str] = None) -> str:
     """Point XLA at a persistent compilation cache (MULTICHIP_r05
@@ -62,8 +65,7 @@ def log_compile_time(what: str, stream=None):
     finally:
         span_cm.__exit__(None, None, None)
         out["secs"] = round(time.perf_counter() - t0, 3)
-        _metrics.gauge("parallel.last_compile_secs",
-                       stable=False).set(out["secs"])
+        _LAST_COMPILE.set(out["secs"])
         print(f"[parallel] {what}: done in {out['secs']:.1f}s",
               file=stream, flush=True)
 

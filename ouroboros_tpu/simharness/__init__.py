@@ -18,7 +18,7 @@ from typing import Any
 from . import runtime as _runtime
 from .core import (
     Async, AsyncCancelled, Deadlock, Sim, SimEvent, Trace, current_sim,
-    mask, run, run_trace,
+    leaked_threads, mask, run, run_trace,
 )
 from .core import (
     atomically as _sim_atomically,
@@ -44,8 +44,9 @@ __all__ = [
     "Partition",
     "Race", "RaceDetector", "RaceReport", "ScheduleController",
     "explore_races",
-    "atomically", "current_sim", "mask", "new_timeout", "now", "run",
-    "run_trace", "sleep", "spawn", "timeout", "trace_event", "yield_",
+    "atomically", "current_sim", "leaked_threads", "mask", "new_timeout",
+    "now", "run", "run_trace", "sleep", "spawn", "timeout", "trace_event",
+    "yield_",
     "Retry", "TBQueue", "TMVar", "TQueue", "TVar", "Tx", "retry",
 ]
 

@@ -509,6 +509,17 @@ def run_trace(main: Coroutine, seed: int = 0,
     return result, sim._trace
 
 
+def leaked_threads(trace: Trace) -> set:
+    """Tids forked during the run that never reached a terminal event
+    (stop/cancelled/fail) — the shared thread-leak gate (chaos sweeps,
+    scrape-endpoint shutdown tests, bench --smoke).  One definition of
+    "terminal" so a future event kind cannot silently skew one copy."""
+    forked = {e.tid for e in trace if e.kind == "fork"}
+    ended = {e.tid for e in trace
+             if e.kind in ("stop", "cancelled", "fail")}
+    return forked - ended
+
+
 def spawn(coro: Coroutine, label: str = "") -> Async:
     return current_sim().spawn(coro, label)
 

@@ -636,7 +636,24 @@ def run_chaos_threadnet(cfg: ChaosConfig, explore: int = 0,
     plan, result, main = _chaos_setup(cfg)
     det0 = sim.RaceDetector(schedule_index=0) if explore > 0 else None
     measured = sim.Sim(seed=cfg.net.seed, collect_trace=True, race=det0)
-    measured.run(main())
+    try:
+        measured.run(main())
+    except BaseException as e:
+        # crash-proof evidence (ISSUE 9): when the flight recorder is
+        # armed, a failing chaos run dumps the sim trace tail alongside
+        # whatever spans/metric deltas the ring already holds.  All
+        # timestamps are VIRTUAL sim time, so the same seed dumps
+        # byte-identical files on every replay of the failure.
+        from ..observe import flight as _flight
+        if _flight.FLIGHT.armed:
+            # the sim has already exited (its runtime is detached), so
+            # each event carries its OWN virtual time — stamping with
+            # monotonic_now() here would leak wall clock into the dump
+            for ev in getattr(measured, "_trace", [])[-256:]:
+                _flight.FLIGHT.note(ev, t=ev.time)
+            _flight.FLIGHT.dump_on_failure(
+                f"chaos threadnet seed={cfg.net.seed}: {e!r}")
+        raise
     result.trace = measured._trace
     result.fault_events = list(plan.events)
     if explore > 0:

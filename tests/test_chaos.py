@@ -157,10 +157,7 @@ def test_keepalive_timeout_kills_stalled_responder_cleanly():
     assert any(e.kind == "fault" for e in trace), \
         "dropped replies left no fault trace events"
     # no leaked sim threads: every fork reached stop/cancelled/fail
-    forked = {e.tid for e in trace if e.kind == "fork"}
-    ended = {e.tid for e in trace
-             if e.kind in ("stop", "cancelled", "fail")}
-    leaked = forked - ended
+    leaked = sim.leaked_threads(trace)
     assert not leaked, f"leaked sim threads: {leaked}"
 
 

@@ -508,3 +508,232 @@ def test_intervals_of_filters_by_cat_and_name():
     assert len(spans.intervals_of(roots, cat="stall")) == 1
     assert len(spans.intervals_of(roots)) == 3
     assert spans.intervals_of(roots, cat="compile") == []
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 9: log-bucket latency histograms with deterministic quantiles
+# ---------------------------------------------------------------------------
+
+def test_latency_buckets_are_log_spaced_and_shared():
+    b = metrics.LATENCY_BUCKETS
+    assert b[0] == 1e-6
+    assert all(b[i + 1] == b[i] * 2 for i in range(len(b) - 1))
+    h = metrics.latency_histogram("lat.vocab.probe")
+    assert h.buckets == b
+    assert not h.stable                    # measured seconds: unstable
+
+
+def test_histogram_quantiles_exact_and_deterministic():
+    reg = MetricsRegistry()
+    h = reg.histogram("q", buckets=(1, 2, 4))
+    for v in (1, 1, 3, 9):
+        h.observe(v)
+    # counts [2, 0, 1, overflow 1]; p50 rank=2 -> top of [0,1];
+    # p95/p99 fall into the overflow bucket -> the top edge
+    assert h.quantile(0.50) == 1.0
+    assert h.quantile(0.95) == 4.0
+    assert h.quantiles() == {"p50": 1.0, "p95": 4.0, "p99": 4.0}
+    # interpolation inside a mid bucket: rank lands in (2,4]
+    h2 = reg.histogram("q2", buckets=(1, 2, 4))
+    for v in (1, 3, 3, 3):
+        h2.observe(v)
+    assert h2.quantile(0.5) == pytest.approx(2.0 + 2.0 * (1.0 / 3.0))
+    assert reg.histogram("qe", buckets=(1, 2)).quantile(0.5) == 0.0
+
+
+def test_histogram_quantiles_creation_order_byte_identical():
+    """The registry-level determinism contract extends to quantiles:
+    same observations, different creation order -> identical snapshot
+    bytes AND identical p50/p95/p99 (they are pure functions of the
+    counts)."""
+    import json as _json
+
+    def build(order):
+        reg = MetricsRegistry()
+        names = ["lat.a", "lat.b"]
+        if order:
+            names.reverse()
+        for n in names:
+            reg.histogram(n, buckets=metrics.LATENCY_BUCKETS)
+        for i in range(20):
+            reg.histogram("lat.a",
+                          buckets=metrics.LATENCY_BUCKETS).observe(
+                              0.001 * (i + 1))
+            reg.histogram("lat.b",
+                          buckets=metrics.LATENCY_BUCKETS).observe(
+                              0.01 * (i + 1))
+        return reg
+    r1, r2 = build(0), build(1)
+    assert r1.snapshot_json() == r2.snapshot_json()
+    q1 = {n: r1.get(n).quantiles() for n in ("lat.a", "lat.b")}
+    q2 = {n: r2.get(n).quantiles() for n in ("lat.a", "lat.b")}
+    assert _json.dumps(q1, sort_keys=True) == _json.dumps(q2,
+                                                          sort_keys=True)
+    assert 0 < q1["lat.a"]["p50"] <= q1["lat.a"]["p95"] \
+        <= q1["lat.a"]["p99"]
+
+
+def test_span_close_feeds_phase_latency_histograms():
+    """Every span close records its duration into latency.phase.<cat>
+    on the GLOBAL registry — live per-phase quantiles for the scrape
+    endpoint without a second instrumentation pass."""
+    h = metrics.REGISTRY.get("latency.phase.device")
+    before = h.count if h is not None else 0
+    rec = SpanRecorder(enabled=True)
+    with rec.span("drain", cat="device"):
+        pass
+    h = metrics.REGISTRY.get("latency.phase.device")
+    assert h is not None and h.count == before + 1
+    rec.drain()
+
+
+def test_prom_quantiles_match_local_quantiles():
+    """A scraper recomputes the SAME p50/p95/p99 from the cumulative
+    exposition buckets that the process reports locally — the
+    obsreport --live contract."""
+    reg = MetricsRegistry()
+    h = reg.histogram("pipe.lat", buckets=metrics.LATENCY_BUCKETS,
+                      stable=False)
+    for i in range(50):
+        h.observe(0.0001 * (i + 1) ** 2)
+    parsed = export.parse_prometheus_text(export.prometheus_text(reg))
+    got = export.prom_histogram_quantiles(parsed, "ouro_pipe_lat")
+    assert got == h.quantiles()
+    assert export.prom_histograms(parsed) == {"ouro_pipe_lat": 50.0}
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 9: flight recorder
+# ---------------------------------------------------------------------------
+
+def _private_flight(capacity=64):
+    from ouroboros_tpu.observe.flight import FlightRecorder
+    reg = MetricsRegistry()
+    rec = SpanRecorder(enabled=False)
+    return FlightRecorder(capacity, registry=reg, recorder=rec), reg, rec
+
+
+def test_flight_recorder_arm_captures_spans_metrics_events():
+    fl, reg, rec = _private_flight()
+    c = reg.counter("f.count")
+    c.inc()                                # before arming: not recorded
+    fl.arm()
+    assert rec.enabled                     # arming forces spans on
+    with rec.span("w", cat="device"):
+        pass
+    c.inc(2)
+    fl.note(TraceForgeEvent(slot=3, outcome="forged"))
+    kinds = [e[1] for e in fl.entries()]
+    assert kinds.count("span") == 1
+    assert kinds.count("event") == 1
+    assert ("f.count" in {e[2] for e in fl.entries()
+                          if e[1] == "metric"})
+    fl.disarm()
+    n = len(fl)
+    c.inc()
+    assert len(fl) == n                    # disarmed: hook detached
+    assert not rec.enabled                 # prior recorder state restored
+
+
+def test_same_cat_nested_span_records_one_phase_sample():
+    """The pipeline's outer "pipeline.drain" wraps JaxBackend's inner
+    "window.drain" (both cat=device): ONE wait, ONE histogram sample —
+    a same-cat child must not double the latency.phase.device count."""
+    h = metrics.REGISTRY.histogram("latency.phase.device",
+                                   buckets=metrics.LATENCY_BUCKETS,
+                                   stable=False)
+    before = h.count
+    rec = SpanRecorder(enabled=True)
+    with rec.span("pipeline.drain", cat="device"):
+        with rec.span("window.drain", cat="device"):
+            pass
+    assert h.count == before + 1
+    # a different-cat child still records under its own phase
+    hc = metrics.REGISTRY.get("latency.phase.compile")
+    before_c = hc.count if hc is not None else 0
+    with rec.span("window.submit", cat="dispatch"):
+        with rec.span("composite", cat="compile"):
+            pass
+    assert metrics.REGISTRY.get("latency.phase.compile").count \
+        == before_c + 1
+    rec.drain()
+
+
+def test_flight_arm_is_reentrant_and_note_takes_explicit_time():
+    """Nested arm()s must not clobber the saved recorder state (the
+    outer disarm restores the TRUE pre-arm state), and note(t=...)
+    keeps an event's own clock reading — the post-mortem sim-trace-tail
+    path stamps virtual time, not the wall clock of the dump."""
+    fl, _reg, rec = _private_flight()
+    assert not rec.enabled
+    fl.arm()
+    fl.arm()                               # reentrant arm
+    fl.disarm()
+    assert not rec.enabled                 # original state restored
+    fl.arm()
+    fl.note(("late", 1), t=3.5)
+    (entry,) = fl.entries()
+    assert entry[0] == 3.5 and entry[1] == "event"
+    assert fl._record(entry)["t"] == 3.5
+    fl.disarm()
+
+
+def test_flight_ring_is_bounded():
+    fl, reg, rec = _private_flight(capacity=8)
+    fl.arm()
+    c = reg.counter("f.many")
+    for _ in range(50):
+        c.inc()
+    assert len(fl) == 8
+    fl.disarm()
+
+
+def test_flight_dump_golden_and_byte_identical_replay(tmp_path):
+    """A seeded sim failure dumps byte-identical flight files on every
+    replay — virtual timestamps only.  Golden regen:
+    OURO_REGEN_GOLDEN=1 pytest tests/test_observe.py"""
+    def one_run(d):
+        fl, reg, rec = _private_flight()
+        fl.arm()
+
+        async def main():
+            with rec.span("window.host_seq", cat="host-seq"):
+                await sim.sleep(1.5)
+            reg.counter("replay.windows").inc()
+            with rec.span("window.drain", cat="device"):
+                await sim.sleep(0.25)
+            fl.note(TraceForgeEvent(slot=7, outcome="error"))
+
+        sim.run(main())
+        out = fl.dump(str(d), reason="forced failure (test)")
+        fl.disarm()
+        return out
+
+    out1 = one_run(tmp_path / "a")
+    out2 = one_run(tmp_path / "b")
+    with open(out1["jsonl"]) as f:
+        text1 = f.read()
+    with open(out2["jsonl"]) as f:
+        assert f.read() == text1           # byte-identical replay
+    with open(out1["trace"]) as f:
+        assert f.read() == open(out2["trace"]).read()
+    _check_golden("flight.jsonl", text1)
+    # the chrome dump loads as a trace_event document
+    doc = json.load(open(out1["trace"]))
+    names = {e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"}
+    assert names == {"window.host_seq", "window.drain"}
+    # header line carries the reason + count
+    head = json.loads(text1.splitlines()[0])
+    assert head["kind"] == "flight" and "forced failure" in head["reason"]
+    assert head["entries"] == len(text1.splitlines()) - 1
+
+
+def test_flight_dump_on_failure_noop_unless_armed(tmp_path, monkeypatch):
+    fl, _reg, _rec = _private_flight()
+    monkeypatch.setenv("OURO_FLIGHT_DIR", str(tmp_path / "fr"))
+    assert fl.dump_on_failure("boom") is None
+    fl.arm()
+    out = fl.dump_on_failure("boom")
+    assert out is not None and os.path.exists(out["jsonl"])
+    assert out["dir"] == str(tmp_path / "fr")
+    fl.disarm()

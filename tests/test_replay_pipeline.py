@@ -361,6 +361,52 @@ def test_error_with_producer_ahead_no_leaks(chain):
         assert not _producer_threads_alive()
 
 
+def test_forced_failure_dumps_flight_record(chain, tmp_path, monkeypatch):
+    """ISSUE 9 acceptance: a forced mid-replay failure with the flight
+    recorder armed produces a dump whose chrome-trace file loads (valid
+    trace_event JSON with the replay spans) and whose JSONL names the
+    failing block in the header reason."""
+    import json
+
+    from ouroboros_tpu.observe.flight import FLIGHT
+
+    ext, blocks, _final = chain
+    bad_ix = 9
+    tampered = _tamper(blocks, bad_ix)
+    monkeypatch.setenv("OURO_FLIGHT_DIR", str(tmp_path / "flight"))
+    FLIGHT.arm()
+    try:
+        res = replay_blocks_pipelined(ext, tampered, ext.initial_state(),
+                                      backend=AsyncStubBackend(),
+                                      window=4)
+    finally:
+        FLIGHT.disarm()
+        FLIGHT.clear()
+    assert not res.all_valid and res.n_valid == bad_ix
+    trace_path = tmp_path / "flight" / "flight.trace.json"
+    jsonl_path = tmp_path / "flight" / "flight.jsonl"
+    assert trace_path.exists() and jsonl_path.exists()
+    doc = json.loads(trace_path.read_text())
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in events}
+    assert {"window.host_seq", "pipeline.drain"} <= names
+    assert all(e["dur"] >= 0 for e in events)
+    lines = jsonl_path.read_text().splitlines()
+    head = json.loads(lines[0])
+    assert head["kind"] == "flight"
+    assert f"block {bad_ix}" in head["reason"]
+    assert head["entries"] == len(lines) - 1
+    kinds = {json.loads(ln)["kind"] for ln in lines[1:]}
+    assert {"span", "metric"} <= kinds
+    # no dump without arming: the error path stays free in normal runs
+    res2 = replay_blocks_pipelined(ext, tampered, ext.initial_state(),
+                                   backend=AsyncStubBackend(), window=4)
+    assert not res2.all_valid
+    assert json.loads((tmp_path / "flight" /
+                       "flight.jsonl").read_text().splitlines()[0]) \
+        == head                            # unchanged by the second run
+
+
 def test_producer_crash_reraises_on_caller(chain):
     """An unexpected exception in the producer (submit machinery broke)
     re-raises on the caller thread and never leaks the producer."""

@@ -589,15 +589,51 @@ def test_obs001_cheap_payloads_allowed():
         "    tracer.trace(EVENT_CONSTANT)\n", "fx.py") == []
 
 
+def test_obs002_unbound_histogram_observe_fires():
+    """`histogram(...).observe(v)` pays a registry lookup per
+    observation — the hot-path form is a pre-bound handle (ISSUE 9)."""
+    f = obs_lint(
+        "def drain(dt):\n"
+        "    _metrics.histogram('pipeline.lat').observe(dt)\n", "fx.py")
+    assert _rules(f) == {"OBS002"}
+    assert f[0].symbol == "drain"
+    # the latency convenience and registry-method forms fire too
+    f = obs_lint(
+        "def drain(reg, dt):\n"
+        "    reg.latency_histogram('x').observe(dt)\n"
+        "    reg.counter('n').inc()\n"
+        "    reg.gauge('g').set(dt)\n", "fx.py")
+    assert _rules(f) == {"OBS002"} and len(f) == 3
+
+
+def test_obs002_prebound_handle_clears_it():
+    assert obs_lint(
+        "_LAT = _metrics.latency_histogram('pipeline.lat')\n"
+        "def drain(dt):\n"
+        "    _LAT.observe(dt)\n", "fx.py") == []
+    # creation alone (bind-at-init) is not a finding — only the chained
+    # write is; nor are reads on a fresh lookup (cold by nature)
+    assert obs_lint(
+        "def init(self):\n"
+        "    self.h = _metrics.histogram('x')\n"
+        "def report(reg):\n"
+        "    return reg.histogram('x').quantiles()\n", "fx.py") == []
+
+
 def test_obs_pass_live_tree_clean_modulo_baseline():
-    """Acceptance (ISSUE 7): the only tolerated unguarded construction
-    sites on the crypto/parallel hot paths carry justifications."""
+    """Acceptance (ISSUE 7 + 9): the only tolerated unguarded
+    construction / unbound instrument-write sites carry
+    justifications."""
     report = run_passes(["obs"], Baseline.load())
     assert report.new == [], "\n".join(f.render() for f in report.new)
     assert report.stale == [], report.stale
-    for e in Baseline.load().entries.get("obs", []):
+    entries = Baseline.load().entries.get("obs", [])
+    for e in entries:
         assert e["justification"].strip() and "TODO" not in \
             e["justification"], e
+    # the OBS002 satellite's justified-baseline contract is exercised by
+    # a real entry (the dynamic-name watchdog counter)
+    assert any(e["rule"] == "OBS002" for e in entries)
 
 
 # --- baseline canonical form -------------------------------------------------

@@ -132,6 +132,11 @@ def test_bench_smoke_parity_gate():
     assert res["overlap_probe"]["host_seq_secs"] > 0
     assert res["vrf_spread_probe"]["ok"]
     assert res["warm_device_fills"] == 0 and res["warm_kes_jobs"] == 0
+    # ISSUE 9: tier-1 gates the scrape endpoint and the perf trajectory
+    assert res["scrape_roundtrip"] and res["scrape_threads_leaked"] == 0
+    q = res["scrape_submit_drain_quantiles"]
+    assert 0 < q["p50"] <= q["p95"] <= q["p99"]
+    assert res["perfgate_ok"]
     assert res["blocks"] == 8
 
 
@@ -140,6 +145,127 @@ def test_bench_cli_flags_exist():
     r = _run("bench.py", "--help")
     assert r.returncode == 0, r.stderr
     assert "--smoke" in r.stdout and "--retune" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# perfgate: the BENCH trajectory as an enforced gate (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+def test_perfgate_passes_on_committed_trajectory():
+    """Acceptance: rc 0 over the real recorded BENCH_r01..rNN rounds."""
+    import glob
+    rounds = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    assert len(rounds) >= 5
+    r = _run("-m", "tools.perfgate", "--check", *rounds)
+    assert r.returncode == 0, r.stdout + r.stderr
+    verdict = json.loads(r.stdout)
+    assert verdict["ok"] is True
+    results = {c["check"]: c["result"] for c in verdict["checks"]}
+    assert results["vs_baseline"] == "pass"
+
+
+def _regressed_round(tmp_path, **fields):
+    import glob
+    import shutil
+    d = tmp_path / "traj"
+    d.mkdir()
+    for p in sorted(glob.glob(os.path.join(REPO, "BENCH_r0*.json"))):
+        shutil.copy(p, d)
+    doc = {"metric": "shelley_replay_proofs_per_sec", "value": 5000.0,
+           "unit": "proofs/s", **fields}
+    (d / "BENCH_r06.json").write_text(
+        json.dumps({"n": 6, "rc": 0, "parsed": doc}))
+    return sorted(str(p) for p in d.glob("BENCH_r0*.json"))
+
+
+def test_perfgate_fails_on_synthetic_regressed_round(tmp_path):
+    """Acceptance: a regressed r06 (vs_baseline dropped past the floor,
+    spread blown, hidden_frac collapsed) exits rc 1 with every check
+    named FAIL."""
+    paths = _regressed_round(tmp_path, vs_baseline=6.0, spread=0.6,
+                             overlap={"hidden_frac_median": 0.05})
+    r = _run("-m", "tools.perfgate", "--check", *paths)
+    assert r.returncode == 1, r.stdout + r.stderr
+    results = {c["check"]: c["result"]
+               for c in json.loads(r.stdout)["checks"]}
+    assert results == {"vs_baseline": "FAIL", "rep_spread": "FAIL",
+                       "hidden_frac": "FAIL"}
+
+
+def test_perfgate_single_check_failure_and_thresholds(tmp_path):
+    """A round that only regresses spread fails exactly that check, and
+    a loosened threshold flips it back to rc 0 (thresholds are real
+    knobs, not decoration)."""
+    paths = _regressed_round(tmp_path, vs_baseline=13.0, spread=0.6)
+    r = _run("-m", "tools.perfgate", "--check", *paths)
+    assert r.returncode == 1
+    results = {c["check"]: c["result"]
+               for c in json.loads(r.stdout)["checks"]}
+    assert results["vs_baseline"] == "pass"
+    assert results["rep_spread"] == "FAIL"
+    assert results["hidden_frac"] == "skipped"
+    r2 = _run("-m", "tools.perfgate", "--max-spread", "0.7",
+              "--check", *paths)
+    assert r2.returncode == 0, r2.stdout
+
+
+def test_perfgate_unreadable_input_is_rc2(tmp_path):
+    bad = tmp_path / "BENCH_r99.json"
+    bad.write_text("not json")
+    r = _run("-m", "tools.perfgate", "--check", str(bad))
+    assert r.returncode == 2 and "cannot judge" in r.stderr
+    r2 = _run("-m", "tools.perfgate")
+    assert r2.returncode == 2
+
+
+def test_obsreport_renders_overlap_section(tmp_path):
+    """Regression (ISSUE 9 satellite): a BENCH_r06-shaped round — the
+    ISSUE 8 `overlap` section with per-rep attributions and medians —
+    renders the hidden-fraction/producer-stall medians instead of being
+    silently dropped."""
+    doc = {
+        "metric": "shelley_replay_proofs_per_sec", "value": 20000.0,
+        "unit": "proofs/s", "vs_baseline": 15.0, "reps": 5,
+        "spread": 0.12,
+        "overlap": {
+            "per_rep": [
+                {"host_seq_secs": 0.8, "device_secs": 2.9,
+                 "host_hidden_secs": 0.7, "hidden_frac": 0.875,
+                 "producer_stall_secs": 0.05}] * 5,
+            "host_seq_secs_median": 0.8,
+            "device_secs_median": 2.9,
+            "host_hidden_secs_median": 0.7,
+            "hidden_frac_median": 0.875,
+            "producer_stall_secs_median": 0.05},
+    }
+    raw = tmp_path / "bench_r06_shape.json"
+    raw.write_text(json.dumps(doc))
+    wrapped = tmp_path / "BENCH_r06.json"
+    wrapped.write_text(json.dumps({"n": 6, "rc": 0, "parsed": doc}))
+    for p in (raw, wrapped):
+        r = _run("-m", "tools.obsreport", str(p))
+        assert r.returncode == 0, r.stderr
+        assert "pipelined-replay overlap (medians over 5 reps)" \
+            in r.stdout
+        assert "hidden fraction" in r.stdout and "0.875" in r.stdout
+        assert "producer permit stalls" in r.stdout and "0.05" in r.stdout
+        assert "88% of the host sequential pass" in r.stdout
+    # pre-ISSUE-8 rounds say so instead of rendering nothing
+    r = _run("-m", "tools.obsreport", "BENCH_r05.json")
+    assert r.returncode == 0
+    assert "no 'overlap' section" in r.stdout
+
+
+def test_obsreport_live_flag_wired():
+    r = _run("-m", "tools.obsreport", "--help")
+    assert r.returncode == 0, r.stderr
+    assert "--live" in r.stdout and "--interval" in r.stdout
+    # --live against a dead port is a clean rc 2, not a traceback
+    r2 = _run("-m", "tools.obsreport", "--live", "127.0.0.1:1")
+    assert r2.returncode == 2 and "cannot scrape" in r2.stderr
+    # PATH and --live are mutually exclusive
+    r3 = _run("-m", "tools.obsreport")
+    assert r3.returncode == 2
 
 
 def test_obsreport_cli(tmp_path):

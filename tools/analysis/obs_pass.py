@@ -1,4 +1,4 @@
-"""Pass 5 — observability hot-path lint (OBS001).
+"""Pass 5 — observability hot-path lint (OBS001, OBS002).
 
 A nop `Tracer` makes the trace() CALL free, but Python still evaluates
 the call's ARGUMENT first: a dataclass event build or an f-string
@@ -18,9 +18,26 @@ payload does work must therefore sit under a `tracer.active` guard:
   an f-string (JoinedStr), a `%`/`+` on strings or a comprehension —
   and no enclosing `if` whose test mentions `.active`.
 
+- OBS002 unbound-instrument-observation (ISSUE 9): a histogram write
+  through a FRESH registry lookup — `histogram("name").observe(v)` /
+  `reg.histogram(...).observe(v)` (and the counter/gauge analogues
+  `.inc(...)`/`.set(...)` chained onto a `counter(`/`gauge(` lookup).
+  Registry creation is an idempotent dict probe plus a kind check —
+  dozens of bytecode ops repeated per observation on paths that run
+  per window or per tx.  Bind the handle ONCE at module/init scope:
+
+    _LAT = _metrics.latency_histogram("pipeline.submit_drain_secs")
+    ...
+    _LAT.observe(dt)                                       # ok
+    _metrics.histogram("pipeline...").observe(dt)          # OBS002
+
+  OBS002 scans the whole ouroboros_tpu package (any module may grow a
+  hot loop); genuinely cold sites — a once-per-scrape handler — are
+  tolerated via justified baseline entries.
+
 Cheap payloads (names, constants, attribute reads, plain tuples of
-those) pass: a tuple build of locals is two bytecode ops, the guard
-would cost as much as it saves.  Cold-path sites (an autotune
+those) pass OBS001: a tuple build of locals is two bytecode ops, the
+guard would cost as much as it saves.  Cold-path sites (an autotune
 measurement that runs once per shape per process) are tolerated via
 justified baseline entries, the same contract as every other pass.
 """
@@ -33,8 +50,18 @@ from . import Finding, register, relpath
 from .astutil import QualnameVisitor, dotted_name, iter_py_files, parse_file
 
 SCAN_DIRS = ("ouroboros_tpu/crypto", "ouroboros_tpu/parallel")
+# OBS002 applies package-wide: pre-binding costs nothing, and hot loops
+# appear outside crypto/ (pipeline drains, mempool admission, mux)
+OBS2_SCAN_DIRS = ("ouroboros_tpu",)
 
 _TRACE_FN_NAMES = {"trace_event", "sim.trace_event"}
+
+# instrument-factory name suffix -> the write method whose chaining we
+# flag (quantile/snapshot reads on a fresh lookup are cold by nature)
+_INSTRUMENT_WRITES = {"histogram": "observe",
+                      "latency_histogram": "observe",
+                      "counter": "inc",
+                      "gauge": "set"}
 
 
 def _is_trace_call(node: ast.Call) -> bool:
@@ -65,11 +92,28 @@ def _guard_mentions_active(test: ast.AST) -> bool:
                for sub in ast.walk(test))
 
 
+def _unbound_instrument_write(node: ast.Call) -> bool:
+    """Is `node` a metric write chained directly onto an instrument
+    FACTORY call — `<...>.histogram("x").observe(v)` and friends?"""
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    recv = node.func.value
+    if not isinstance(recv, ast.Call):
+        return False
+    factory = dotted_name(recv.func)
+    if factory is None:
+        return False
+    leaf = factory.rsplit(".", 1)[-1]
+    return _INSTRUMENT_WRITES.get(leaf) == node.func.attr
+
+
 class _ObsLint(QualnameVisitor):
-    def __init__(self, file: str, findings: List[Finding]):
+    def __init__(self, file: str, findings: List[Finding],
+                 rules: Iterable[str] = ("OBS001", "OBS002")):
         super().__init__()
         self.file = file
         self.findings = findings
+        self.rules = frozenset(rules)
         self._guard_depth = 0
 
     def visit_If(self, node: ast.If):
@@ -90,7 +134,8 @@ class _ObsLint(QualnameVisitor):
         self.visit(node.orelse)
 
     def visit_Call(self, node: ast.Call):
-        if _is_trace_call(node) and self._guard_depth == 0:
+        if "OBS001" in self.rules and _is_trace_call(node) \
+                and self._guard_depth == 0:
             payload = list(node.args) + [kw.value for kw in node.keywords]
             if any(_expensive(a) for a in payload):
                 self.findings.append(Finding(
@@ -100,24 +145,45 @@ class _ObsLint(QualnameVisitor):
                             "tracer that may be nop; guard the call "
                             "site with `if tracer.active:` on hot "
                             "paths"))
+        if "OBS002" in self.rules and _unbound_instrument_write(node):
+            self.findings.append(Finding(
+                file=self.file, line=node.lineno, rule="OBS002",
+                symbol=self.qualname,
+                message="instrument write through a fresh registry "
+                        "lookup; pre-bind the handle once "
+                        "(H = metrics.histogram(...)) at module/init "
+                        "scope and call H.observe(v) on the hot path"))
         self.generic_visit(node)
 
 
-def lint_source(source: str, file: str) -> List[Finding]:
+def lint_source(source: str, file: str,
+                rules: Iterable[str] = ("OBS001", "OBS002")
+                ) -> List[Finding]:
     """Run the OBS pass over one source text (fixture entry point)."""
     findings: List[Finding] = []
-    _ObsLint(file, findings).visit(ast.parse(source, filename=file))
+    _ObsLint(file, findings, rules).visit(
+        ast.parse(source, filename=file))
     return sorted(set(findings))
 
 
-def run_files(paths: Iterable[str]) -> List[Finding]:
+def run_files(paths: Iterable[str],
+              rules: Iterable[str] = ("OBS001", "OBS002")
+              ) -> List[Finding]:
     findings: List[Finding] = []
     for path in paths:
-        lint = _ObsLint(relpath(path), findings)
+        lint = _ObsLint(relpath(path), findings, rules)
         lint.visit(parse_file(path))
     return sorted(set(findings))
 
 
 @register("obs")
 def run() -> List[Finding]:
-    return run_files(iter_py_files(*SCAN_DIRS))
+    # OBS001+OBS002 on the crypto/parallel hot paths; OBS002 alone over
+    # the rest of the package (OBS001's tracer-payload rule would drown
+    # in the cold protocol layers, where a guard costs more than it
+    # saves — the unbound-handle rule is cheap to satisfy anywhere)
+    hot = set(iter_py_files(*SCAN_DIRS))
+    findings = run_files(sorted(hot))
+    rest = [p for p in iter_py_files(*OBS2_SCAN_DIRS) if p not in hot]
+    findings += run_files(sorted(rest), rules=("OBS002",))
+    return sorted(set(findings))

@@ -1,8 +1,9 @@
 """obsreport — human-readable summary of a bench round's observability
-sections.
+sections, and a live view of a running node's scrape endpoint.
 
     python -m tools.obsreport BENCH_r05.json
     python bench.py > out.json && python -m tools.obsreport out.json
+    python -m tools.obsreport --live 127.0.0.1:9187 [--interval 5]
 
 Accepts either a raw bench JSON object (what `python bench.py` prints)
 or a harness record wrapping one under ``parsed`` (the committed
@@ -14,13 +15,22 @@ BENCH_r*.json files).  Prints, in order:
   reps, with the dominant phase (largest absolute spread) starred.
   This is the attributed form of the old bare "vrf spread 45%" warning:
   the starred row names WHERE the cross-rep seconds moved;
+- the ``overlap`` section (ISSUE 8): host-seq seconds hidden under
+  in-flight device windows, hidden fraction and producer permit stalls
+  — cross-rep medians;
 - the precompute cache stats (hit/miss/device_fill/eviction);
 - the registry metrics snapshot (the deterministic subset bench embeds).
 
 Rounds recorded before the observability layer (ISSUE 7) lack the
-``phases``/``variance``/``metrics`` sections; each missing section is
-reported as absent rather than failing, so the CLI works across the
-whole BENCH_r*.json history.
+``phases``/``variance``/``metrics`` sections and pre-ISSUE-8 rounds
+lack ``overlap``; each missing section is reported as absent rather
+than failing, so the CLI works across the whole BENCH_r*.json history.
+
+``--live ADDR`` scrapes a running process's metrics endpoint
+(observe/scrape.py, served over the project's own snocket/SDU
+transport) and renders replay progress (blocks done / ETA / blocks per
+sec / windows in flight / hidden fraction) plus p50/p95/p99 for every
+latency histogram — repeat with ``--interval N``.
 
 Exit codes: 0 report printed, 2 unreadable/unrecognised input.
 """
@@ -33,6 +43,14 @@ from typing import List, Optional
 from ouroboros_tpu.observe.spans import PHASES  # jax-free
 
 PHASE_ORDER = PHASES + ("other",)
+
+OVERLAP_MEDIANS = (
+    ("host_seq_secs_median", "host-seq total"),
+    ("device_secs_median", "device drains"),
+    ("host_hidden_secs_median", "host-seq hidden under device"),
+    ("hidden_frac_median", "hidden fraction"),
+    ("producer_stall_secs_median", "producer permit stalls"),
+)
 
 
 def load_bench(path: str) -> dict:
@@ -110,6 +128,25 @@ def render(doc: dict) -> str:
         out.append("no 'variance' section (round predates the "
                    "observability layer)")
 
+    # -- host/device overlap (ISSUE 8 section; ISSUE 9 renders it) ----------
+    out.append("")
+    ov = doc.get("overlap") or {}
+    if any(k in ov for k, _ in OVERLAP_MEDIANS):
+        reps = len(ov.get("per_rep") or ())
+        out.append(f"pipelined-replay overlap (medians over "
+                   f"{reps or '?'} reps):")
+        rows = [[label, ov.get(key, "-")] for key, label in
+                OVERLAP_MEDIANS if key in ov]
+        out += _table(rows, ["quantity", "median"])
+        hf = ov.get("hidden_frac_median")
+        if isinstance(hf, (int, float)):
+            out.append(f"{100 * hf:.0f}% of the host sequential pass ran "
+                       f"while a window was in flight on device — the "
+                       f"closer to 100%, the closer host time is to free")
+    else:
+        out.append("no 'overlap' section (round predates the threaded "
+                   "producer/consumer replay attribution)")
+
     # -- precompute cache ---------------------------------------------------
     out.append("")
     pc = doc.get("precompute")
@@ -137,17 +174,112 @@ def render(doc: dict) -> str:
     return "\n".join(out) + "\n"
 
 
+# ---------------------------------------------------------------------------
+# --live: render a scraped exposition (replay progress + latency quantiles)
+# ---------------------------------------------------------------------------
+
+PROGRESS_GAUGES = (
+    ("ouro_replay_progress_blocks_done", "blocks done"),
+    ("ouro_replay_progress_total_blocks", "total blocks"),
+    ("ouro_replay_progress_windows_in_flight", "windows in flight"),
+    ("ouro_replay_progress_blocks_per_sec", "blocks/sec"),
+    ("ouro_replay_progress_eta_secs", "ETA (s)"),
+    ("ouro_replay_progress_hidden_frac", "hidden fraction"),
+)
+
+
+def render_live(parsed: dict) -> str:
+    """One live frame from a parsed exposition: replay progress, then
+    p50/p95/p99 of every histogram present (recomputed scraper-side from
+    the cumulative buckets — byte-identical to the serving process's own
+    quantiles for the same counts)."""
+    from ouroboros_tpu.observe.export import (
+        prom_histogram_quantiles, prom_histograms,
+    )
+    out: List[str] = []
+    prog = [(label, parsed[key]) for key, label in PROGRESS_GAUGES
+            if key in parsed]
+    if prog:
+        done = parsed.get("ouro_replay_progress_blocks_done")
+        total = parsed.get("ouro_replay_progress_total_blocks")
+        if total:
+            out.append(f"replay progress: {done:.0f}/{total:.0f} blocks "
+                       f"({100 * done / total:.1f}%)")
+        out.append("")
+        out += _table([[l, v] for l, v in prog], ["progress", "value"])
+    else:
+        out.append("no replay.progress.* gauges in this exposition")
+    out.append("")
+    hists = prom_histograms(parsed)
+    if hists:
+        rows = []
+        for base, count in sorted(hists.items()):
+            if not count:
+                continue               # nothing observed yet: skip
+            q = prom_histogram_quantiles(parsed, base)
+            rows.append([base, int(count), q["p50"], q["p95"], q["p99"]])
+        out.append("latency/size histograms (quantiles from scraped "
+                   "buckets):")
+        out += _table(rows, ["histogram", "count", "p50", "p95", "p99"])
+    return "\n".join(out) + "\n"
+
+
+def _live_once(addr: str) -> str:
+    """One scrape over the project transport: host:port dials TCP, a
+    /path dials the Unix socket."""
+    from ouroboros_tpu.network.snocket import snocket_for
+    from ouroboros_tpu.observe.scrape import scrape
+    from ouroboros_tpu.simharness import io_run
+    if addr.startswith("/"):
+        target: object = addr
+    else:
+        host, port = addr.rsplit(":", 1)
+        target = (host, int(port))
+    return io_run(scrape(snocket_for(target), target))
+
+
 def main(argv: Optional[List[str]] = None) -> int:
-    argv = sys.argv[1:] if argv is None else argv
-    if len(argv) != 1 or argv[0] in ("-h", "--help"):
-        print(__doc__.split("\n\n")[0] + "\n\n"
-              "usage: python -m tools.obsreport BENCH_rNN.json",
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.obsreport",
+        description="render a bench round's observability sections, or "
+                    "--live: a running node's scrape endpoint")
+    ap.add_argument("path", nargs="?", help="BENCH_rNN.json round file")
+    ap.add_argument("--live", metavar="ADDR",
+                    help="scrape host:port (or /unix/path) and render "
+                         "replay progress + latency quantiles")
+    ap.add_argument("--interval", type=float, default=0.0,
+                    help="with --live: re-scrape every N seconds until "
+                         "interrupted (default: once)")
+    args = ap.parse_args(sys.argv[1:] if argv is None else argv)
+    if (args.path is None) == (args.live is None):
+        ap.print_usage(sys.stderr)
+        print("obsreport: give exactly one of PATH or --live ADDR",
               file=sys.stderr)
         return 2
+    if args.live:
+        from ouroboros_tpu.observe.export import parse_prometheus_text
+        try:
+            while True:
+                sys.stdout.write(
+                    render_live(parse_prometheus_text(
+                        _live_once(args.live))))
+                sys.stdout.flush()
+                if args.interval <= 0:
+                    return 0
+                import time
+                time.sleep(args.interval)
+                sys.stdout.write("\n")
+        except KeyboardInterrupt:
+            return 0
+        except Exception as e:
+            print(f"obsreport: cannot scrape {args.live}: {e}",
+                  file=sys.stderr)
+            return 2
     try:
-        doc = load_bench(argv[0])
+        doc = load_bench(args.path)
     except (OSError, ValueError, json.JSONDecodeError) as e:
-        print(f"obsreport: cannot read {argv[0]}: {e}", file=sys.stderr)
+        print(f"obsreport: cannot read {args.path}: {e}", file=sys.stderr)
         return 2
     sys.stdout.write(render(doc))
     return 0

@@ -1,0 +1,164 @@
+"""perfgate — the measured BENCH trajectory as an ENFORCED gate.
+
+    python -m tools.perfgate --check BENCH_r*.json
+    python -m tools.perfgate BENCH_r01.json BENCH_r02.json ...
+
+The repo's performance story lives in the BENCH_r01..rNN round files
+(2.03x -> 5.30x -> 2.65x -> 5.66x -> 12.13x vs the CPU baseline so
+far); until now that trajectory was prose in ROADMAP.md — a regression
+like the r03 dip was only caught by a human reading the numbers.  This
+tool turns it into a merge gate: the LATEST round is judged against the
+rounds before it and the run fails (rc 1) on any of
+
+- **vs_baseline drop**: latest ``vs_baseline`` below the best earlier
+  round by more than ``--max-drop`` (default 0.25 — r03's 50% dip would
+  have failed this gate the day it landed);
+- **best-rep spread**: latest rep spread ((max-min)/median over timed
+  reps) above ``--max-spread`` (default 0.45 — the BENCH_r05 "45% vrf
+  spread" class of instability);
+- **hidden fraction**: latest ``overlap.hidden_frac_median`` (the
+  pipelined replay's host-under-device hiding, recorded since ISSUE 8)
+  below ``--min-hidden-frac`` (default 0.25) — the producer/consumer
+  overlap silently degrading back to additive host+device time.
+
+Checks only apply where the round records the field (early rounds lack
+spread/overlap sections), so the gate passes on the committed
+r01..r05 history as-is and `bench --smoke` runs it in tier-1.
+
+Exit codes: 0 pass, 1 regression, 2 unreadable/unrecognised input.
+One JSON verdict object is printed on stdout either way.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import List, Optional
+
+# reuse obsreport's tolerant loader (raw bench JSON, harness-wrapped
+# ``parsed``, JSON-line lists)
+from tools.obsreport import load_bench
+
+DEFAULT_MAX_DROP = 0.25
+DEFAULT_MAX_SPREAD = 0.45
+DEFAULT_MIN_HIDDEN_FRAC = 0.25
+
+
+def _round_no(path: str) -> Optional[int]:
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def load_round(path: str) -> dict:
+    """One trajectory point: the fields the gate judges, plus identity."""
+    doc = load_bench(path)
+    overlap = doc.get("overlap") or {}
+    return {
+        "path": os.path.basename(path),
+        "round": _round_no(path),
+        "metric": doc.get("metric"),
+        "value": doc.get("value"),
+        "vs_baseline": doc.get("vs_baseline"),
+        "spread": doc.get("spread"),
+        "hidden_frac": overlap.get("hidden_frac_median"),
+    }
+
+
+def check_trajectory(paths: List[str],
+                     max_drop: float = DEFAULT_MAX_DROP,
+                     max_spread: float = DEFAULT_MAX_SPREAD,
+                     min_hidden_frac: float = DEFAULT_MIN_HIDDEN_FRAC
+                     ) -> dict:
+    """Judge the newest round of `paths` against the rest; returns the
+    verdict dict ({"ok": bool, "checks": [...], ...}).  Raises ValueError
+    on inputs that are not bench rounds (rc 2 at the CLI)."""
+    if not paths:
+        raise ValueError("no bench rounds given")
+    rounds = [load_round(p) for p in paths]
+    # newest last: by recorded round number when the filenames carry one,
+    # else by the order given
+    if all(r["round"] is not None for r in rounds):
+        rounds.sort(key=lambda r: r["round"])
+    latest, earlier = rounds[-1], rounds[:-1]
+    checks: List[dict] = []
+
+    def check(name: str, ok: Optional[bool], detail: str) -> None:
+        checks.append({"check": name,
+                       "result": ("skipped" if ok is None
+                                  else "pass" if ok else "FAIL"),
+                       "detail": detail})
+
+    prev_best = max((r["vs_baseline"] for r in earlier
+                     if r["vs_baseline"] is not None), default=None)
+    if latest["vs_baseline"] is None or prev_best is None:
+        check("vs_baseline", None, "field absent in latest or history")
+    else:
+        floor = prev_best * (1.0 - max_drop)
+        check("vs_baseline", latest["vs_baseline"] >= floor,
+              f"latest {latest['vs_baseline']}x vs best earlier "
+              f"{prev_best}x (floor {floor:.3f}x at max_drop={max_drop})")
+
+    if latest["spread"] is None:
+        check("rep_spread", None, "no 'spread' field in latest round")
+    else:
+        check("rep_spread", latest["spread"] <= max_spread,
+              f"latest rep spread {latest['spread']} vs allowed "
+              f"{max_spread}")
+
+    if latest["hidden_frac"] is None:
+        check("hidden_frac", None,
+              "no 'overlap.hidden_frac_median' in latest round "
+              "(pre-ISSUE-8 rounds lack it)")
+    else:
+        check("hidden_frac", latest["hidden_frac"] >= min_hidden_frac,
+              f"latest hidden_frac {latest['hidden_frac']} vs floor "
+              f"{min_hidden_frac}")
+
+    return {"ok": all(c["result"] != "FAIL" for c in checks),
+            "latest": latest["path"],
+            "rounds": [{"path": r["path"],
+                        "vs_baseline": r["vs_baseline"]} for r in rounds],
+            "thresholds": {"max_drop": max_drop,
+                           "max_spread": max_spread,
+                           "min_hidden_frac": min_hidden_frac},
+            "checks": checks}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.perfgate",
+        description="fail (rc 1) when the newest BENCH round regresses "
+                    "the measured trajectory")
+    ap.add_argument("paths", nargs="*", help="BENCH_rNN.json round files")
+    ap.add_argument("--check", nargs="+", default=[], metavar="PATH",
+                    help="additional round files (alias for positionals)")
+    ap.add_argument("--max-drop", type=float, default=DEFAULT_MAX_DROP,
+                    help="max fractional vs_baseline drop from the best "
+                         f"earlier round (default {DEFAULT_MAX_DROP})")
+    ap.add_argument("--max-spread", type=float,
+                    default=DEFAULT_MAX_SPREAD,
+                    help="max rep spread in the latest round "
+                         f"(default {DEFAULT_MAX_SPREAD})")
+    ap.add_argument("--min-hidden-frac", type=float,
+                    default=DEFAULT_MIN_HIDDEN_FRAC,
+                    help="min pipelined-replay hidden fraction "
+                         f"(default {DEFAULT_MIN_HIDDEN_FRAC})")
+    args = ap.parse_args(argv)
+    paths = list(args.paths) + list(args.check)
+    try:
+        verdict = check_trajectory(paths, max_drop=args.max_drop,
+                                   max_spread=args.max_spread,
+                                   min_hidden_frac=args.min_hidden_frac)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"perfgate: cannot judge trajectory: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(verdict, indent=2, sort_keys=True))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    raise SystemExit(main())
