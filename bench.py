@@ -27,6 +27,15 @@ of silently skewing a rep (the BENCH_r05 VRF regression).  `--retune`
 drops the persisted choices and re-measures.  `--smoke` runs a tiny
 parity-only replay (1 rep, no timing assertions) — the tier-1 guard that
 keeps the replay path honest between bench rounds.
+
+`--mesh N` (ISSUE 11) additionally replays the same chain through the
+sharded pipelined driver — ShardedJaxBackend over an N-device mesh, the
+threaded producer/consumer pipeline with per-shard packed windows and
+the device-side verdict fold — and reports sharded proofs/s (and the
+per-shard padding waste) beside the single-device number under a
+``sharded`` key.  In this container the mesh is N forced host-platform
+XLA devices (the flag is set before jax initialises); on TPU the same
+knob shards over the real chips.
 """
 import argparse
 import glob
@@ -176,22 +185,29 @@ def _overlap_summary(rep_overlaps) -> dict:
     return out
 
 
-def previous_bench():
-    """Latest recorded BENCH_r*.json, for the primitives-vs-previous-round
-    comparison the bench prints itself (VERDICT r3 next-step 1e)."""
-    best = None
+def bench_rounds():
+    """Every recorded BENCH_r*.json as (round_no, parsed-result dict),
+    ascending — the one loader for all history comparisons (harness
+    wrapping unwrapped, unreadable files tolerated)."""
+    out = []
     for path in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
         m = re.search(r"BENCH_r(\d+)\.json$", path)
         if not m:
             continue
         try:
-            data = json.load(open(path))
+            with open(path) as f:
+                data = json.load(f)
         except Exception:
             continue
-        rnd = int(m.group(1))
-        if best is None or rnd > best[0]:
-            best = (rnd, data)
-    return best
+        out.append((int(m.group(1)), data.get("parsed", data)))
+    return sorted(out)
+
+
+def previous_bench():
+    """Latest recorded round, for the primitives-vs-previous-round
+    comparison the bench prints itself (VERDICT r3 next-step 1e)."""
+    rounds = bench_rounds()
+    return rounds[-1] if rounds else None
 
 
 def synth_chain(tmp: str, extra: tuple = ()) -> str:
@@ -362,6 +378,12 @@ def bench_primitives(jb):
 
     def run_vrf():
         assert all(jb.verify_vrf_batch(vreqs))
+        # re-fence INSIDE the rep (ISSUE 11, the r04->r05 follow-up):
+        # the verdict transfer syncs the fold output, but a rep must not
+        # end while donated temporaries are still retiring — the next
+        # rep's pre-fence would hide that tail OUTSIDE the timing and
+        # re-expose it as run-to-run spread
+        _device_fence()
     run_vrf()                               # warm/compile (+ autotune)
     vals = _timed_reps(run_vrf)             # + one fenced warmup rep
     med, spread = check_spread("vrf primitive", vals)
@@ -386,17 +408,56 @@ def bench_primitives(jb):
 
 
 def compare_previous(prim):
+    """Log primitive deltas vs the latest recorded round and return them
+    for the output JSON ({} when no history)."""
     prev = previous_bench()
     if not prev:
-        return
-    rnd, data = prev
-    old = data.get("parsed", data).get("primitives") or {}
+        return {}
+    rnd, doc = prev
+    old = doc.get("primitives") or {}
+    ratios = {}
     for k in ("ed25519_batch_per_sec", "vrf_batch_per_sec",
               "kes_batch_per_sec"):
         if k in old and k in prim and old[k]:
             delta = prim[k] / old[k]
+            ratios[k] = round(delta, 3)
             log(f"vs BENCH_r{rnd:02d} {k}: {old[k]:.0f} -> {prim[k]:.0f} "
                 f"({delta:.2f}x)")
+    return {"vs_round": rnd, "ratios": ratios}
+
+
+def vrf_attribution(prim):
+    """The r04->r05 VRF primitive regression, attributed in-band (ISSUE
+    11 satellite): if this round's vrf primitive throughput is below the
+    best recorded round, the output JSON carries a note naming the two
+    mechanical changes between the r04 and r05+ measurements — the
+    primitive moved to the FOLD-form program (1 B/proof verdict transfer
+    instead of 130 B point rows) and, since r06, autotunes under its own
+    ("vrff", m) key instead of inheriting a choice pinned on the rows
+    form the window composite measures.  Returns None when the round
+    recovered (>= best)."""
+    best = None
+    for rnd, doc in bench_rounds():
+        v = (doc.get("primitives") or {}).get("vrf_batch_per_sec")
+        if v and (best is None or v > best[1]):
+            best = (rnd, v)
+    cur = prim.get("vrf_batch_per_sec")
+    if best is None or cur is None or cur >= best[1]:
+        return None
+    return {
+        "regressed_vs_round": best[0],
+        "best_per_sec": best[1],
+        "current_per_sec": cur,
+        "note": ("verify_vrf_batch measures the fold-form program "
+                 "(verify + on-device challenge fold, 1 B/proof "
+                 "transfer) under its own ('vrff', m) autotune key; "
+                 "r05 measured it under the rows-form ('vrf', m) key "
+                 "pinned by the window composite AND shipped 130 "
+                 "B/proof over the ~20 MB/s tunnel, which is both the "
+                 "r04->r05 throughput drop and its 45% spread. If this "
+                 "round is still below the best, the variance section "
+                 "names the phase that moved."),
+    }
 
 
 def _cpu_backend():
@@ -543,6 +604,7 @@ def smoke(blocks: int = 8, window: int = 8):
         vrf_probe = _smoke_vrf_spread(jb)
         scrape_ok, scrape_leaked, scrape_q = _smoke_scrape()
         perfgate_ok, _perfgate_verdict = _smoke_perfgate()
+        sharded_probe = _smoke_sharded_replay(rules, blocks_l)
         result = {"metric": "bench_smoke", "value": 1.0,
                   "blocks": len(blocks_l), "proofs": n_proofs,
                   "state_hash_parity": bool(hash_ok),
@@ -562,6 +624,7 @@ def smoke(blocks: int = 8, window: int = 8):
                   "scrape_threads_leaked": int(scrape_leaked),
                   "scrape_submit_drain_quantiles": scrape_q,
                   "perfgate_ok": bool(perfgate_ok),
+                  "sharded_replay_smoke": sharded_probe,
                   "precompute": GLOBAL_PRECOMPUTE_CACHE.stats()}
         if not (hash_ok and verdict_ok and fold_ok
                 and producers_run >= 1 and leaked == 0
@@ -572,7 +635,7 @@ def smoke(blocks: int = 8, window: int = 8):
                 and snapshot_ok and disabled_writes == 0
                 and disabled_spans == 0
                 and scrape_ok and scrape_leaked == 0
-                and perfgate_ok):
+                and perfgate_ok and sharded_probe["ok"]):
             result["value"] = 0.0
             print(json.dumps(result))
             raise SystemExit(f"bench --smoke parity failure: {result}")
@@ -731,15 +794,110 @@ def _smoke_scrape():
 def _smoke_perfgate():
     """Run the trajectory gate over the committed BENCH_r*.json rounds —
     tier-1 fails the moment a regressed round is recorded (the prose
-    trajectory in ROADMAP becomes an enforced gate)."""
-    from tools.perfgate import check_trajectory
+    trajectory in ROADMAP becomes an enforced gate).  Since ISSUE 11 the
+    MULTICHIP rounds ride along: once a green sharded-replay round is
+    recorded, a later red mesh round (rc!=0, unattributed compile, or
+    parity lost) fails tier-1 too — rounds predating the sharded replay
+    are tolerated as skipped."""
+    from tools.perfgate import check_multichip, check_trajectory
     paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
     if not paths:
         return True, {"checks": [], "note": "no recorded rounds"}
     verdict = check_trajectory(paths)
+    mc_paths = sorted(glob.glob(os.path.join(REPO, "MULTICHIP_r*.json")))
+    if mc_paths:
+        mc = check_multichip(mc_paths)
+        verdict["multichip"] = mc
+        verdict["ok"] = verdict["ok"] and mc["ok"]
     if not verdict["ok"]:
-        log(f"perfgate FAILED: {json.dumps(verdict['checks'])}")
+        log(f"perfgate FAILED: {json.dumps(verdict['checks'])} "
+            f"{json.dumps(verdict.get('multichip', {}).get('checks', []))}")
     return verdict["ok"], verdict
+
+
+def _smoke_sharded_replay(rules, blocks_l, mesh_n: int = 2,
+                          window: int = 4):
+    """Sharded pipelined replay smoke (ISSUE 11): over `mesh_n` forced
+    host-platform devices, the threaded sharded ReplayResult must be
+    byte-identical to the synchronous single-device driver on a valid,
+    a tampered, and a truncated chain, with zero leaked producer
+    threads.
+
+    Gated on the COST, not just the API surface: a sharded composite
+    costs minutes of XLA:CPU compile (257s/182s measured at exactly
+    these smoke shapes) — past the whole tier-1 budget — regardless of
+    whether shard_map is experimental (this container's jax 0.4.x) or
+    graduated, so the probe skips on host-platform devices and on
+    experimental-only shard_map, recording why.  Real accelerators run
+    it per smoke; `OURO_SMOKE_MESH=1` forces it anywhere (e.g. a
+    CPU-only CI lane with a long budget);
+    `__graft_entry__.dryrun_multichip` covers the mesh path per round
+    in this container."""
+    import jax
+    forced = os.environ.get("OURO_SMOKE_MESH") == "1"
+    if not forced and not hasattr(jax, "shard_map"):
+        return {"ok": True,
+                "skipped": "experimental-only shard_map: sharded "
+                           "composite compile (~3-4 min XLA:CPU) "
+                           "exceeds the tier-1 budget; covered by "
+                           "dryrun_multichip + slow sharded parity "
+                           "tests"}
+    if not forced and jax.devices()[0].platform not in ("tpu", "gpu"):
+        return {"ok": True,
+                "skipped": "host-platform devices: the sharded "
+                           "composite's multi-minute XLA:CPU compile "
+                           "exceeds the tier-1 budget on any jax "
+                           "version (OURO_SMOKE_MESH=1 forces the "
+                           "probe); covered by dryrun_multichip"}
+    if len(jax.devices()) < mesh_n:
+        return {"ok": False, "skipped": None,
+                "error": f"need {mesh_n} devices, have "
+                         f"{len(jax.devices())} (XLA_FLAGS host-device "
+                         f"forcing must precede jax init)"}
+    from ouroboros_tpu.consensus.batch import replay_blocks_pipelined
+    from ouroboros_tpu.consensus.headers import ProtocolBlock
+    from ouroboros_tpu.crypto.backend import GLOBAL_BETA_CACHE
+    from ouroboros_tpu.eras.shelley import KES_FIELD
+    from ouroboros_tpu.parallel import ShardedJaxBackend, make_mesh
+
+    def tamper(blks, ix):
+        blk = blks[ix]
+        sig = bytearray(blk.header.get(KES_FIELD))
+        sig[3] ^= 1
+        out = list(blks)
+        out[ix] = ProtocolBlock(
+            blk.header.with_fields(**{KES_FIELD: bytes(sig)}), blk.body)
+        return out
+
+    sb = ShardedJaxBackend(make_mesh(mesh_n), min_bucket=16)
+    cpu = _cpu_backend()
+    variants = [list(blocks_l), tamper(blocks_l, 5),
+                list(blocks_l[:3]) + list(blocks_l[4:])]
+    ok = True
+    details = []
+    for blks in variants:
+        GLOBAL_BETA_CACHE.clear()
+        sync = replay_blocks_pipelined(rules, blks, rules.initial_state(),
+                                       backend=cpu, window=window)
+        GLOBAL_BETA_CACHE.clear()
+        shard = replay_blocks_pipelined(rules, blks,
+                                        rules.initial_state(),
+                                        backend=sb, window=window)
+        same = (shard.n_valid == sync.n_valid
+                and (shard.error is None) == (sync.error is None)
+                and ((shard.final_state is None)
+                     == (sync.final_state is None))
+                and (sync.final_state is None
+                     or (shard.final_state.ledger.state_hash()
+                         == sync.final_state.ledger.state_hash())))
+        ok = ok and same
+        details.append({"n_valid": [sync.n_valid, shard.n_valid],
+                        "match": bool(same)})
+    leaked = _smoke_producer_leak()
+    return {"ok": bool(ok and leaked == 0), "skipped": None,
+            "devices": mesh_n, "variants": details,
+            "producer_threads_leaked": int(leaked),
+            "padding": sb.padding_stats()}
 
 
 def _clear_beta_cache():
@@ -747,7 +905,67 @@ def _clear_beta_cache():
     GLOBAL_BETA_CACHE.clear()
 
 
-def main():
+def _mesh_leg(rules, blocks, cpu_hash, cpu_secs, tpu_secs, n_proofs,
+              mesh_n: int):
+    """The sharded pipelined replay leg of the bench (ISSUE 11): the
+    SAME chain and window size through replay_blocks_pipelined over a
+    ShardedJaxBackend — threaded producer/consumer, per-shard packed
+    windows, fold verdicts — with the identical measurement discipline
+    (cold-beta warmup x2, fenced timed reps, state-hash parity per rep).
+    Returns the ``sharded`` dict for the output JSON."""
+    import jax
+
+    from ouroboros_tpu.crypto.backend import GLOBAL_BETA_CACHE
+    from ouroboros_tpu.parallel import (
+        ShardedJaxBackend, log_compile_time, make_mesh,
+    )
+    if len(jax.devices()) < mesh_n:
+        raise SystemExit(
+            f"--mesh {mesh_n}: only {len(jax.devices())} devices "
+            f"visible (host-platform forcing happens before jax init; "
+            f"re-run as a fresh process)")
+    sb = TimingBackend(ShardedJaxBackend(make_mesh(mesh_n)))
+    # warmup replay 1: compiles BOTH sharded window shapes (beta-carrying
+    # and final beta-free) + the fold programs, fills the key cache —
+    # attributed so a multi-minute XLA:CPU compile is named, not mystery
+    with log_compile_time(f"mesh={mesh_n} sharded replay warmup"):
+        GLOBAL_BETA_CACHE.clear()
+        replay(rules, blocks, sb, WINDOW)
+    # warmup replay 2: warm key cache, steady-state shapes
+    GLOBAL_BETA_CACHE.clear()
+    replay(rules, blocks, sb, WINDOW)
+    pad0 = sb.padding_stats()
+    times, dev_times, disp_times = [], [], []
+    for _ in range(REPS):
+        GLOBAL_BETA_CACHE.clear()
+        _device_fence()
+        sb.device_secs = sb.dispatch_secs = 0.0
+        secs, mesh_hash, _ = replay(rules, blocks, sb, WINDOW)
+        assert mesh_hash == cpu_hash, \
+            "sharded replay state hash parity violated"
+        times.append(secs)
+        dev_times.append(sb.device_secs)
+        disp_times.append(sb.dispatch_secs)
+    med, spread = check_spread("sharded replay", times)
+    return {
+        "devices": mesh_n,
+        "proofs_per_sec": round(n_proofs / med, 1),
+        "vs_baseline": round(cpu_secs / med, 3),
+        "vs_single_device": round(tpu_secs / med, 3),
+        "replay_secs": {"median": round(med, 3),
+                        "min": round(min(times), 3),
+                        "max": round(max(times), 3)},
+        "spread": round(spread, 3),
+        # same attribution discipline as the single-device breakdown:
+        # consumer-thread blocking drains vs producer-thread pack+submit
+        "device_wait_secs": round(statistics.median(dev_times), 3),
+        "dispatch_secs": round(statistics.median(disp_times), 3),
+        "state_hash_parity": True,
+        "padding": sb.padding_stats(since=pad0),   # timed reps only
+    }
+
+
+def main(mesh_n: int = None):
     from ouroboros_tpu.crypto.jax_backend import JaxBackend
 
     tmp = tempfile.mkdtemp(prefix="bench-shelley-")
@@ -865,7 +1083,16 @@ def main():
 
         prim = bench_primitives(JaxBackend())
         log(f"primitives: {prim}")
-        compare_previous(prim)
+        prim_vs_prev = compare_previous(prim)
+        vrf_attr = vrf_attribution(prim)
+        if vrf_attr:
+            log(f"vrf primitive below best recorded round: {vrf_attr}")
+
+        sharded = None
+        if mesh_n:
+            sharded = _mesh_leg(rules, blocks, cpu_hash, cpu_secs,
+                                tpu_secs, n_proofs, mesh_n)
+            log(f"sharded (mesh={mesh_n}): {sharded}")
 
         # belt-and-braces: a frozen write RAISES at the store site (the
         # except above / _timed_reps), so reaching here with a nonzero
@@ -906,6 +1133,9 @@ def main():
                 for k, v in jb._inner.kernel_choices.items()},
             "precompute": GLOBAL_PRECOMPUTE_CACHE.stats(),
             "primitives": prim,
+            "primitives_vs_previous": prim_vs_prev,
+            **({"vrf_attribution": vrf_attr} if vrf_attr else {}),
+            **({"sharded": sharded} if sharded else {}),
         }))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
@@ -919,11 +1149,28 @@ if __name__ == "__main__":
     ap.add_argument("--retune", action="store_true",
                     help="invalidate the persisted kernel choices and "
                          "re-measure pallas-vs-XLA from scratch")
+    ap.add_argument("--mesh", type=int, default=None, metavar="N",
+                    help="also run the sharded pipelined replay over an "
+                         "N-device mesh (forced host-platform devices "
+                         "off-TPU) and report sharded proofs/s beside "
+                         "the single-device number")
     args = ap.parse_args()
     if args.retune:
         # tuner_for() reads this when the first backend is constructed
         os.environ["OURO_RETUNE"] = "1"
+    if args.mesh or args.smoke:
+        # mesh legs need multiple XLA devices; forcing host-platform
+        # devices only works BEFORE jax initialises, which is why this
+        # sits in __main__ (module level stays jax-free) and why the
+        # flag is a no-op on real TPU platforms (it only multiplies the
+        # HOST platform's device count)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            n = max(args.mesh or 0, 2)
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
     if args.smoke:
         smoke()
     else:
-        main()
+        main(mesh_n=args.mesh)

@@ -239,6 +239,15 @@ def replay_blocks_pipelined(
     `supports_window_fold` the drain is a device-folded WindowVerdict
     (one scalar pair) instead of a per-proof vector.
 
+    A ShardedJaxBackend (parallel/sharded_verify.py) rides this same
+    driver unchanged (ISSUE 11): the producer pads each window to the
+    per-shard bucket shape, the window composite shard_maps the packed
+    cores over the mesh, and the fold verdict's min-reduction already
+    spans shards — first-error-wins is preserved because the failing
+    request INDEX, not a per-shard flag, is what crosses the link.
+    `bench.py --mesh N` and the multichip dryrun are the measured
+    entry points.
+
     Falls back to the synchronous windowed driver on backends without
     submit_window."""
     import itertools

@@ -102,6 +102,12 @@ _P_INFLIGHT = _metrics.gauge("replay.progress.windows_in_flight")
 _P_RATE = _metrics.gauge("replay.progress.blocks_per_sec", stable=False)
 _P_ETA = _metrics.gauge("replay.progress.eta_secs", stable=False)
 _P_HIDDEN = _metrics.gauge("replay.progress.hidden_frac", stable=False)
+# mesh attribution (ISSUE 11): devices the in-flight windows shard over
+# (1 off-mesh) and the lane padding waste the per-shard bucket rounding
+# cost this replay — both read straight off the backend, published so a
+# live scrape of a sharded replay names its mesh
+_P_DEVICES = _metrics.gauge("replay.progress.devices")
+_P_PAD_WASTE = _metrics.gauge("replay.progress.padding_waste_frac")
 
 
 class ProgressTracker:
@@ -356,6 +362,15 @@ def replay_threaded(ext_rules, blocks, ext_state, backend,
     if total_blocks is None and hasattr(blocks, "__len__"):
         total_blocks = len(blocks)
     fold = bool(getattr(backend, "supports_window_fold", False))
+    # the sharded backend (parallel/sharded_verify.py) drives this SAME
+    # driver: the producer's packing pads window w+1 to the per-shard
+    # bucket shape (backend._pad rounds to a mesh multiple) while window
+    # w's sharded composite drains, and the fold verdict is already the
+    # cross-shard minimum — nothing here branches on mesh size, but the
+    # mesh is attributed for live observers
+    _P_DEVICES.set(int(getattr(backend, "n_shards", 1)))
+    stats_fn = getattr(backend, "padding_stats", None)
+    pad0 = stats_fn() if stats_fn is not None else None
     shared = _Shared()
     shared.progress = ProgressTracker(total_blocks)
     t = threading.Thread(
@@ -395,6 +410,11 @@ def replay_threaded(ext_rules, blocks, ext_state, backend,
         for entry in shared.pending:
             backend.finish_window(entry[1])
         shared.pending.clear()
+        if stats_fn is not None:
+            # THIS replay's windows only (since=): a long-lived backend
+            # must not smear earlier replays' padding into the gauge
+            _P_PAD_WASTE.set(
+                stats_fn(since=pad0).get("waste_frac", 0.0))
     if shared.crash is not None:
         # unhandled producer error: the flight ring holds the last
         # spans/metric deltas before the crash — dump before re-raising

@@ -48,6 +48,12 @@ from .precompute import GLOBAL_PRECOMPUTE_CACHE
 _WINDOWS = _metrics.counter("jax_backend.windows_submitted")
 _COMPOSITE_BUILDS = _metrics.counter("jax_backend.composite_builds")
 _FOLD_WINDOWS = _metrics.counter("jax_backend.fold_windows")
+# lane occupancy: real requests vs padded bucket lanes per window — the
+# mesh backend's padding additionally rounds to a mesh multiple, so the
+# waste fraction (1 - used/padded) is the per-shard occupancy cost the
+# MULTICHIP_OBS / bench --mesh artifacts report (ISSUE 11)
+_LANES_USED = _metrics.counter("jax_backend.lanes_used")
+_LANES_PADDED = _metrics.counter("jax_backend.lanes_padded")
 
 # device-side verdict-fold sentinel: "no failing request".  int32 max so
 # jnp.min over any real request index beats it; request lists are bounded
@@ -145,6 +151,12 @@ class JaxBackend(CryptoBackend):
                        if autotune else None)
         # static-path choices recorded for kernel_choices() reporting
         self._static_choice: dict = {}
+        # per-instance lane occupancy accumulators (padding_stats());
+        # written only on the submit path, which has a single writer
+        # thread in the pipelined replay (the producer)
+        self._lanes_used = 0
+        self._lanes_padded = 0
+        self._windows_padded = 0
 
     # -- subclass seams (ShardedJaxBackend overrides both) -------------------
     def _pad(self, n: int) -> int:
@@ -157,6 +169,68 @@ class JaxBackend(CryptoBackend):
         the mesh backend device_puts with the window-axis sharding."""
         import jax.numpy as jnp
         return jnp.asarray(a)
+
+    # -- lane occupancy ------------------------------------------------------
+    def _note_padding(self, used: int, padded: int) -> None:
+        """Record one window's lane occupancy (real requests vs padded
+        bucket lanes across every component batch).  Runs on the submit
+        path — the producer thread in the pipelined replay."""
+        self._lanes_used += used
+        self._lanes_padded += padded
+        self._windows_padded += 1
+        _LANES_USED.inc(used)
+        _LANES_PADDED.inc(padded)
+
+    @property
+    def n_shards(self) -> int:
+        """Devices the window batch is split over (1 off-mesh; the mesh
+        backend overrides via its mesh size)."""
+        return 1
+
+    def padding_stats(self, since: Optional[dict] = None) -> dict:
+        """Lane occupancy over every window this instance submitted:
+        ``waste_frac`` is the fraction of padded lanes that carried no
+        real request — on the mesh backend the same fraction per shard,
+        since sharding splits the padded batch evenly.  The MULTICHIP
+        dryrun and ``bench --mesh`` embed this dict.  Pass a previously
+        returned dict as `since` to get the delta (one replay's windows
+        instead of the instance lifetime)."""
+        used, padded = self._lanes_used, self._lanes_padded
+        windows = self._windows_padded
+        if since is not None:
+            used -= since["lanes_used"]
+            padded -= since["lanes_padded"]
+            windows -= since["windows"]
+        per_shard = padded // (self.n_shards * max(windows, 1))
+        return {
+            "windows": windows,
+            "lanes_used": used,
+            "lanes_padded": padded,
+            "waste_frac": round(1.0 - used / padded, 4) if padded
+            else 0.0,
+            "shards": self.n_shards,
+            "lanes_per_shard_per_window": per_shard,
+        }
+
+    def prewarm_window(self, reqs, next_beta_proofs=(),
+                       fold: bool = False):
+        """Run one full window for `reqs` NOW — compiling its composite
+        (and, with fold=True, the verdict-fold program) outside any
+        timed/timeout-budgeted region — returning ``(wall_seconds, ok)``:
+        the seconds (dominated by XLA compile on a cold cache) plus the
+        window's verdicts — the per-request bool vector, or with
+        fold=True the WindowVerdict scalar (gate on ``ok.all_ok``) — so
+        callers assert correctness on THIS run instead of paying a
+        duplicate window for it.  Shared by the single-device and mesh
+        paths (MULTICHIP_r05 follow-up: a silent 4m25s compile inside
+        the timed region turned into rc=124 with zero attribution; the
+        dryrun pre-warms and reports this number instead)."""
+        import time as _time
+        t0 = _time.perf_counter()
+        with _spans.span("window.prewarm", cat="compile"):
+            ok, _ = self.finish_window(
+                self.submit_window(reqs, next_beta_proofs, fold=fold))
+        return _time.perf_counter() - t0, ok
 
     # -- measured kernel selection ------------------------------------------
     @property
@@ -536,6 +610,9 @@ class JaxBackend(CryptoBackend):
         if kes_msgs:
             nk = self._pad(len(kes_msgs))
             kes_args = self._prep_kes_hash(kes_msgs, kes_expects, nk)
+        self._note_padding(
+            len(ed_reqs) + len(vrf_reqs) + len(beta_proofs) + len(kes_msgs),
+            ne + nv + nb + nk)
         if (ed_args is None and vrf_args is None and beta_args is None
                 and kes_args is None):
             packed = None

@@ -143,6 +143,34 @@ def test_jax_backend_vrf_and_kes():
         == [True] * 5 + [False]
 
 
+def test_vrf_batch_autotunes_under_its_own_key(monkeypatch):
+    """ISSUE 11 satellite (the r04->r05 VRF primitive regression):
+    verify_vrf_batch measures/pins under its OWN ("vrff", m) autotune
+    key — the fold-form verify+challenge program pair — never the
+    ("vrf", m) rows-form key the window composite pins.  r05 shared the
+    key, inheriting a choice measured on the wrong program for
+    whichever path ran second (fixed in r06; this pins the fix).
+    Reuses the fold kernel shape test_jax_backend_vrf_and_kes already
+    compiled in this process."""
+    from ouroboros_tpu.crypto import vrf_ref
+    from ouroboros_tpu.crypto.backend import VrfReq
+    from ouroboros_tpu.crypto.jax_backend import JaxBackend
+    jb = JaxBackend(min_bucket=16, use_pallas=False, autotune=False)
+    keys = []
+    orig = JaxBackend._pick
+
+    def spy(self, key, run_pallas, run_xla):
+        keys.append(key)
+        return orig(self, key, run_pallas, run_xla)
+    monkeypatch.setattr(JaxBackend, "_pick", spy)
+    vsk = hashlib.sha256(b"vrff-key").digest()
+    vvk = vrf_ref.public_key(vsk)
+    reqs = [VrfReq(vvk, b"a%d" % i, vrf_ref.prove(vsk, b"a%d" % i))
+            for i in range(8)]
+    assert jb.verify_vrf_batch(reqs) == [True] * 8
+    assert keys == [("vrff", 16)]
+
+
 def test_vrf_jax_batch_parity_and_betas():
     """batch_verify_vrf + batch_betas vs the pure-Python oracle, incl.
     tampered gamma/c/s, wrong vk, wrong alpha, garbage proofs."""

@@ -501,3 +501,98 @@ def test_pipeline_sim_model_race_free_at_k16():
         rep2 = sim.explore_races(make_model(fail_at=fail_at), k=16,
                                  seed=0)
         assert rep.render() == rep2.render()    # deterministic
+
+
+# ---------------------------------------------------------------------------
+# Sharded pipelined replay (ISSUE 11): ShardedJaxBackend through the SAME
+# threaded driver — per-shard padded windows, cross-shard fold verdicts.
+# The cheap accounting tests run in tier-1; the full mesh parity sweep is
+# slow-marked (one sharded composite costs minutes of XLA:CPU on this
+# container's experimental-shard_map jax) and tier-1 gates the same path
+# through `bench --smoke`'s sharded probe where affordable.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.device
+def test_padding_stats_accounting():
+    """padding_stats: lane occupancy accumulates per submitted window
+    and waste_frac is the padded-lane fraction carrying no request."""
+    pytest.importorskip("jax")
+    from ouroboros_tpu.crypto.jax_backend import JaxBackend
+    jb = JaxBackend(min_bucket=16, use_pallas=False, autotune=False)
+    assert jb.padding_stats()["windows"] == 0
+    jb._note_padding(24, 32)
+    jb._note_padding(8, 16)
+    st = jb.padding_stats()
+    assert st == {"windows": 2, "lanes_used": 32, "lanes_padded": 48,
+                  "waste_frac": round(1 - 32 / 48, 4), "shards": 1,
+                  "lanes_per_shard_per_window": 24}
+    jb._note_padding(4, 16)
+    delta = jb.padding_stats(since=st)
+    assert (delta["windows"], delta["lanes_used"],
+            delta["lanes_padded"]) == (1, 4, 16)
+    assert delta["waste_frac"] == 0.75
+
+
+@pytest.mark.device
+def test_sharded_backend_pads_to_per_shard_buckets():
+    """The mesh backend's padding seam: batches round up to a mesh
+    multiple past the bucket floor, and padding_stats attributes lanes
+    per shard."""
+    jax = pytest.importorskip("jax")
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 XLA devices (conftest forces 8)")
+    from ouroboros_tpu.parallel import ShardedJaxBackend, make_mesh
+    sb = ShardedJaxBackend(make_mesh(2), min_bucket=16)
+    assert sb.n_shards == 2
+    assert sb._pad(5) == 16       # bucket floor
+    assert sb._pad(17) == 18      # mesh-multiple rounding past the floor
+    sb._note_padding(17, 18)
+    st = sb.padding_stats()
+    assert st["shards"] == 2
+    assert st["lanes_per_shard_per_window"] == 9
+
+
+@pytest.mark.device
+@pytest.mark.slow
+def test_sharded_threaded_result_identical_to_sync_driver(chain):
+    """ISSUE 11 acceptance: under the forced-host-device mesh, the
+    sharded threaded ReplayResult is byte-identical to the synchronous
+    single-device driver on a valid, a tampered, and a truncated chain,
+    with zero leaked producer threads and per-shard padding accounted.
+    slow: compiles two sharded window composites (~minutes of XLA:CPU
+    each on experimental-shard_map jax); tier-1 gates the same path via
+    bench --smoke's sharded probe on containers where it is
+    affordable."""
+    jax = pytest.importorskip("jax")
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 XLA devices (conftest forces 8)")
+    from ouroboros_tpu.crypto.backend import GLOBAL_BETA_CACHE
+    from ouroboros_tpu.parallel import ShardedJaxBackend, make_mesh
+    ext, blocks, _final = chain
+    sb = ShardedJaxBackend(make_mesh(2), min_bucket=16)
+    s0, f0 = _producer_counters()
+    variants = [list(blocks), _tamper(blocks, 9),
+                list(blocks[:7]) + list(blocks[8:])]
+    for blks in variants:
+        GLOBAL_BETA_CACHE.clear()
+        sync = replay_blocks_pipelined(ext, blks, ext.initial_state(),
+                                       backend=BACKEND, window=8)
+        GLOBAL_BETA_CACHE.clear()
+        thr = replay_blocks_pipelined(ext, blks, ext.initial_state(),
+                                      backend=sb, window=8)
+        assert thr.n_valid == sync.n_valid
+        assert (thr.error is None) == (sync.error is None)
+        if sync.final_state is None:
+            assert thr.final_state is None
+        else:
+            assert (thr.final_state.ledger.state_hash()
+                    == sync.final_state.ledger.state_hash())
+    # the sync driver spawns no producer (no submit_window); each of the
+    # three sharded replays spawned and joined exactly one
+    s1, f1 = _producer_counters()
+    assert (s1 - s0, f1 - f0) == (3, 3)
+    assert not _producer_threads_alive()
+    st = sb.padding_stats()
+    assert st["shards"] == 2 and st["windows"] >= 3
+    assert 0.0 <= st["waste_frac"] < 1.0

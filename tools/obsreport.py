@@ -2,12 +2,18 @@
 sections, and a live view of a running node's scrape endpoint.
 
     python -m tools.obsreport BENCH_r05.json
+    python -m tools.obsreport MULTICHIP_r06.json
     python bench.py > out.json && python -m tools.obsreport out.json
     python -m tools.obsreport --live 127.0.0.1:9187 [--interval 5]
 
-Accepts either a raw bench JSON object (what `python bench.py` prints)
-or a harness record wrapping one under ``parsed`` (the committed
-BENCH_r*.json files).  Prints, in order:
+Accepts a raw bench JSON object (what `python bench.py` prints), a
+harness record wrapping one under ``parsed`` (the committed
+BENCH_r*.json files), or a MULTICHIP_rNN.json mesh-dryrun record
+(``{n_devices, rc, tail}`` — the MULTICHIP_OBS/MESH_SCALING JSON lines
+are recovered from the stored stdout tail and rendered as a mesh
+section: devices, prewarm/compile attribution, per-shard padding waste,
+and sharded vs single-device replay throughput when both legs are
+recorded).  For a bench round it prints, in order:
 
 - the headline (proofs/s, speedup vs the CPU baseline, rep spread);
 - the per-phase table from the ``variance`` section — median / min /
@@ -175,6 +181,101 @@ def render(doc: dict) -> str:
 
 
 # ---------------------------------------------------------------------------
+# MULTICHIP mesh-dryrun rounds (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def load_multichip(path: str) -> Optional[dict]:
+    """The multichip harness record from `path`, or None when the file
+    is not one (callers fall through to load_bench).  The MULTICHIP_OBS
+    and MESH_SCALING JSON lines are parsed out of the stored tail under
+    ``obs``/``scaling`` (None when the round died before printing them —
+    the rc says how)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(doc, dict) or "rc" not in doc \
+            or "n_devices" not in doc:
+        return None
+    out = {"n_devices": doc.get("n_devices"), "rc": doc.get("rc"),
+           "ok": doc.get("ok"), "obs": None, "scaling": None}
+    for line in (doc.get("tail") or "").splitlines():
+        for marker, key in (("MULTICHIP_OBS ", "obs"),
+                            ("MESH_SCALING ", "scaling")):
+            i = line.find(marker)
+            if i < 0:
+                continue
+            try:
+                out[key] = json.loads(line[i + len(marker):])
+            except json.JSONDecodeError:
+                pass
+    return out
+
+
+def render_multichip(doc: dict) -> str:
+    """Mesh section of a MULTICHIP round: run identity, compile
+    attribution, the sharded pipelined replay (parity, throughput,
+    per-shard occupancy/padding waste) and the single-device comparison
+    leg when the round recorded one."""
+    out: List[str] = []
+    out.append(f"multichip dryrun: {doc.get('n_devices', '?')} devices, "
+               f"rc={doc.get('rc')} "
+               f"({'green' if doc.get('rc') == 0 else 'RED'})")
+    obs = doc.get("obs")
+    if not obs:
+        out.append("no MULTICHIP_OBS line in the stored tail (the round "
+                   "died before attribution, or predates ISSUE 6)")
+        return "\n".join(out) + "\n"
+
+    compile_rows = [[k, obs[k]] for k in sorted(obs)
+                    if k.endswith("_compile_secs")]
+    if compile_rows:
+        out.append("")
+        out.append("compile attribution (seconds outside timed regions):")
+        out += _table(compile_rows, ["stage", "secs"])
+    if "over_budget_after" in obs:
+        out.append(f"OVER BUDGET after '{obs['over_budget_after']}' "
+                   f"({obs.get('elapsed_secs')}s of "
+                   f"{obs.get('budget_secs')}s)")
+
+    sh = obs.get("sharded_replay")
+    out.append("")
+    if sh:
+        out.append("sharded pipelined replay (the real chain, not the "
+                   "prewarm window):")
+        rows = [[k, sh[k]] for k in sorted(sh) if k != "padding"]
+        out += _table(rows, ["field", "value"])
+        pad = sh.get("padding") or {}
+        if pad:
+            out.append("per-shard occupancy / padding waste:")
+            out += _table([[k, pad[k]] for k in sorted(pad)],
+                          ["stat", "value"])
+        single = obs.get("single_device_replay") or {}
+        sp, dp = (single.get("proofs_per_sec"),
+                  sh.get("proofs_per_sec"))
+        if sp and dp:
+            out.append(f"sharded vs single-device: {dp} vs {sp} proofs/s "
+                       f"({dp / sp:.2f}x on this mesh)")
+        elif dp:
+            out.append("no single-device leg recorded (budget-gated); "
+                       "sharded throughput stands alone")
+    else:
+        out.append("no sharded_replay section (round predates the "
+                   "sharded pipelined replay, ISSUE 11)")
+
+    scaling = doc.get("scaling")
+    if scaling:
+        out.append("")
+        out.append(f"mesh scaling: wall {scaling.get('wall_secs')} / "
+                   f"dispatches per window "
+                   f"{scaling.get('dispatches_per_window')} "
+                   f"(relative n-vs-1: "
+                   f"{scaling.get('relative_wall_n_vs_1')})")
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
 # --live: render a scraped exposition (replay progress + latency quantiles)
 # ---------------------------------------------------------------------------
 
@@ -185,6 +286,8 @@ PROGRESS_GAUGES = (
     ("ouro_replay_progress_blocks_per_sec", "blocks/sec"),
     ("ouro_replay_progress_eta_secs", "ETA (s)"),
     ("ouro_replay_progress_hidden_frac", "hidden fraction"),
+    ("ouro_replay_progress_devices", "mesh devices"),
+    ("ouro_replay_progress_padding_waste_frac", "padding waste frac"),
 )
 
 
@@ -276,6 +379,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"obsreport: cannot scrape {args.live}: {e}",
                   file=sys.stderr)
             return 2
+    mc = load_multichip(args.path)
+    if mc is not None:
+        sys.stdout.write(render_multichip(mc))
+        return 0
     try:
         doc = load_bench(args.path)
     except (OSError, ValueError, json.JSONDecodeError) as e:

@@ -25,6 +25,27 @@ Checks only apply where the round records the field (early rounds lack
 spread/overlap sections), so the gate passes on the committed
 r01..r05 history as-is and `bench --smoke` runs it in tier-1.
 
+``--multichip MULTICHIP_r*.json`` additionally gates the MULTICHIP
+trajectory (the mesh dryrun artifacts: ``{n_devices, rc, ok, tail}``
+with a ``MULTICHIP_OBS {json}`` line in the stdout tail since ISSUE 6).
+The latest multichip round must be green end to end:
+
+- **rc**: exit code 0 — a timeout (rc 124) or budget overrun (rc 3) is
+  a red round;
+- **compile attribution**: the MULTICHIP_OBS line is present and
+  carries at least one ``*_compile_secs`` field (a red with no
+  attribution is the MULTICHIP_r05 failure mode the dryrun was
+  rebuilt to prevent);
+- **sharded replay parity**: the obs ``sharded_replay`` section reports
+  ``state_hash_parity`` true (the real pipelined mesh replay, ISSUE 11).
+
+The multichip checks only become BINDING once at least one recorded
+round carries the ``sharded_replay`` section: historical rounds predate
+the sharded pipelined replay (r01-r05 have no MULTICHIP_OBS at all, or
+none with that section), and the gate reports their checks as skipped
+instead of failing tier-1 retroactively.  From the first green sharded
+round onward, a later red round fails the gate.
+
 Exit codes: 0 pass, 1 regression, 2 unreadable/unrecognised input.
 One JSON verdict object is printed on stdout either way.
 """
@@ -126,6 +147,83 @@ def check_trajectory(paths: List[str],
             "checks": checks}
 
 
+# ---------------------------------------------------------------------------
+# MULTICHIP trajectory gate (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def load_multichip_round(path: str) -> dict:
+    """One multichip trajectory point: the harness record's rc plus the
+    MULTICHIP_OBS object recovered from the stored stdout tail (absent on
+    rounds that died before printing it — exactly the red shape the rc
+    check exists for)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "rc" not in doc:
+        raise ValueError(f"{path}: not a multichip round (no 'rc' field)")
+    obs = None
+    for line in (doc.get("tail") or "").splitlines():
+        marker = line.find("MULTICHIP_OBS ")
+        if marker < 0:
+            continue
+        try:
+            obs = json.loads(line[marker + len("MULTICHIP_OBS "):])
+        except json.JSONDecodeError:
+            pass          # truncated tail: treat as unattributed
+    return {"path": os.path.basename(path),
+            "round": _round_no(path),
+            "rc": doc.get("rc"),
+            "n_devices": doc.get("n_devices"),
+            "obs": obs}
+
+
+def _compile_attributed(obs: Optional[dict]) -> bool:
+    return bool(obs) and any(k.endswith("_compile_secs")
+                             and obs[k] is not None for k in obs)
+
+
+def check_multichip(paths: List[str]) -> dict:
+    """Judge the newest MULTICHIP round; returns a verdict dict like
+    check_trajectory's.  Checks are binding only once some recorded
+    round carries the ``sharded_replay`` obs section (see module doc)."""
+    if not paths:
+        raise ValueError("no multichip rounds given")
+    rounds = [load_multichip_round(p) for p in paths]
+    if all(r["round"] is not None for r in rounds):
+        rounds.sort(key=lambda r: r["round"])
+    latest = rounds[-1]
+    binding = any(r["obs"] and "sharded_replay" in r["obs"]
+                  for r in rounds)
+    checks: List[dict] = []
+
+    def check(name: str, ok: bool, detail: str) -> None:
+        if not binding:
+            result = "skipped"
+            detail += " [advisory: no sharded-replay round recorded yet]"
+        else:
+            result = "pass" if ok else "FAIL"
+        checks.append({"check": name, "result": result, "detail": detail})
+
+    check("rc", latest["rc"] == 0,
+          f"latest {latest['path']} rc={latest['rc']}")
+    check("compile_attribution", _compile_attributed(latest["obs"]),
+          "MULTICHIP_OBS line with *_compile_secs fields "
+          + ("present" if _compile_attributed(latest["obs"]) else "MISSING"))
+    sharded = (latest["obs"] or {}).get("sharded_replay") or {}
+    check("sharded_replay_parity",
+          sharded.get("state_hash_parity") is True,
+          f"latest sharded_replay section: "
+          f"{ {k: sharded[k] for k in sorted(sharded) if k != 'padding'} }"
+          if sharded else "no sharded_replay section in latest round")
+
+    return {"ok": all(c["result"] != "FAIL" for c in checks),
+            "latest": latest["path"],
+            "binding": binding,
+            "rounds": [{"path": r["path"], "rc": r["rc"],
+                        "attributed": _compile_attributed(r["obs"])}
+                       for r in rounds],
+            "checks": checks}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.perfgate",
@@ -145,12 +243,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                     default=DEFAULT_MIN_HIDDEN_FRAC,
                     help="min pipelined-replay hidden fraction "
                          f"(default {DEFAULT_MIN_HIDDEN_FRAC})")
+    ap.add_argument("--multichip", nargs="+", default=[], metavar="PATH",
+                    help="MULTICHIP_rNN.json round files: gate the mesh "
+                         "dryrun trajectory (rc=0, compile attribution, "
+                         "sharded replay parity) alongside — or instead "
+                         "of — the BENCH rounds")
     args = ap.parse_args(argv)
     paths = list(args.paths) + list(args.check)
+    if not paths and not args.multichip:
+        print("perfgate: no rounds given", file=sys.stderr)
+        return 2
+    verdict: dict = {"ok": True}
     try:
-        verdict = check_trajectory(paths, max_drop=args.max_drop,
-                                   max_spread=args.max_spread,
-                                   min_hidden_frac=args.min_hidden_frac)
+        if paths:
+            verdict = check_trajectory(
+                paths, max_drop=args.max_drop,
+                max_spread=args.max_spread,
+                min_hidden_frac=args.min_hidden_frac)
+        if args.multichip:
+            mc = check_multichip(args.multichip)
+            verdict["multichip"] = mc
+            verdict["ok"] = verdict["ok"] and mc["ok"]
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"perfgate: cannot judge trajectory: {e}", file=sys.stderr)
         return 2
