@@ -51,20 +51,14 @@ class BatchValidationResult:
         return self.states[-1] if self.states else None
 
 
-def validate_headers_batched(
-        protocol: ConsensusProtocol,
-        headers: Sequence[Any],
-        header_state: HeaderState,
-        ledger_view_for: Callable[[int, Any], Any],
-        backend: Optional[CryptoBackend] = None) -> BatchValidationResult:
-    """Validate a window of headers with one device batch for all proofs.
-
-    Equivalent to folding validate_header, but ~window-size× fewer device
-    round trips.  `ledger_view_for(i, header)` supplies the ledger view for
-    header i (from forecasts during sync, or the tip view during replay).
-    """
-    backend = backend or default_backend()
-    protocol.prefetch_window(headers, backend)
+def _seq_header_pass(protocol: ConsensusProtocol, headers: Sequence[Any],
+                     header_state: HeaderState,
+                     ledger_view_for: Callable[[int, Any], Any]):
+    """Pass 1 (host, sequential, cheap): envelope + tick + reupdate fold,
+    collecting proof obligations per header.  Shared by the direct
+    batched path below and the VerifyService-coalesced path
+    (crypto/batching.validate_headers_coalesced) so the two can never
+    drift.  Returns (states, proofs, owner, seq_error, n_seq)."""
     states: list[HeaderState] = []
     proofs: list = []
     owner: list[int] = []          # proofs[j] belongs to headers[owner[j]]
@@ -93,9 +87,15 @@ def validate_headers_batched(
         owner.extend([i] * len(reqs))
         states.append(st)
         n_seq += 1
+    return states, proofs, owner, seq_error, n_seq
 
-    # one device batch for every proof in the window
-    ok = _verify_mixed(backend, proofs) if proofs else []
+
+def _merge_header_verdicts(headers: Sequence[Any], states: list,
+                           proofs: list, owner: list, ok: Sequence,
+                           seq_error: Optional[Exception],
+                           n_seq: int) -> BatchValidationResult:
+    """Fold the proof verdict vector back into the valid prefix (the
+    other half shared with the coalesced path)."""
     first_bad = n_seq
     bad_proof: Optional[int] = None
     for j, good in enumerate(ok):
@@ -109,6 +109,29 @@ def validate_headers_batched(
     else:
         err = seq_error
     return BatchValidationResult(states[:first_bad], first_bad, err)
+
+
+def validate_headers_batched(
+        protocol: ConsensusProtocol,
+        headers: Sequence[Any],
+        header_state: HeaderState,
+        ledger_view_for: Callable[[int, Any], Any],
+        backend: Optional[CryptoBackend] = None) -> BatchValidationResult:
+    """Validate a window of headers with one device batch for all proofs.
+
+    Equivalent to folding validate_header, but ~window-size× fewer device
+    round trips.  `ledger_view_for(i, header)` supplies the ledger view for
+    header i (from forecasts during sync, or the tip view during replay).
+    """
+    backend = backend or default_backend()
+    protocol.prefetch_window(headers, backend)
+    states, proofs, owner, seq_error, n_seq = _seq_header_pass(
+        protocol, headers, header_state, ledger_view_for)
+
+    # one device batch for every proof in the window
+    ok = _verify_mixed(backend, proofs) if proofs else []
+    return _merge_header_verdicts(headers, states, proofs, owner, ok,
+                                  seq_error, n_seq)
 
 
 def _seq_block_step(protocol: ConsensusProtocol, ledger, st: ExtLedgerState,

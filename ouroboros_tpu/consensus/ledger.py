@@ -64,6 +64,16 @@ class LedgerRules:
         Default: none (mock ledgers check structurally)."""
         return []
 
+    def tx_proofs(self, state: Any, tx: Any) -> Optional[list]:
+        """Independent crypto obligations of ONE tx — the mempool
+        admission unit (extract_proofs at tx granularity).  The adaptive
+        batching service pre-verifies these coalesced with other
+        threads' traffic, then apply_tx runs with the verdicts honored
+        (Mempool.try_add_txs_async).  None = unknown: witness crypto
+        stays inside apply_tx and the service path degrades to the
+        plain synchronous admission."""
+        return None
+
     # -- protocol support -----------------------------------------------------
     def ledger_view(self, state: Any) -> Any:
         """Projection consumed by the consensus protocol

@@ -22,6 +22,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
 
 from ..chain.block import Point
+from ..crypto.backend import default_backend as _default_backend
 from ..observe import metrics as _metrics
 from ..observe.spans import monotonic_now as _now
 from ..utils import cbor
@@ -91,11 +92,15 @@ class Mempool:
     def __init__(self, ledger_rules: LedgerRules,
                  get_ledger: Callable[[], tuple],
                  capacity_bytes: int = 2 * 65536,
-                 backend=None):
+                 backend=None, verify_service=None):
         self.rules = ledger_rules
         self.get_ledger = get_ledger
         self.capacity_bytes = capacity_bytes
         self.backend = backend
+        # adaptive batching service (crypto/batching.py): when attached,
+        # try_add_txs_async coalesces witness checks with every other
+        # protocol thread's single-proof traffic
+        self.verify_service = verify_service
         self._entries: list[MempoolEntry] = []
         self._last_arrival: Optional[float] = None
         self._next_ticket = 1
@@ -127,12 +132,15 @@ class Mempool:
                 self.version._value = self._version_int
 
     # -- API (API.hs:53-155) --------------------------------------------------
-    def try_add_txs(self, txs: Sequence[Any]) -> tuple[list, list]:
+    def try_add_txs(self, txs: Sequence[Any],
+                    backend=None) -> tuple[list, list]:
         """Validate and admit txs against the current mempool state.
 
         Returns (added_txids, [(tx, error)rejected]).  Stops admitting (but
         keeps rejecting-on-validity) when capacity is reached, like
-        tryAddTxs's MempoolCapacityBytesOverride behaviour.
+        tryAddTxs's MempoolCapacityBytesOverride behaviour.  `backend`
+        overrides the mempool's own for this call (the service admission
+        path passes a PrecheckedBackend carrying coalesced verdicts).
         """
         observing = _metrics.enabled()
         if observing:
@@ -151,8 +159,10 @@ class Mempool:
                 rejected.append((tx, LedgerError("duplicate tx")))
                 continue
             try:
-                new_state = self.rules.apply_tx(self._state, tx,
-                                                backend=self.backend)
+                new_state = self.rules.apply_tx(
+                    self._state, tx,
+                    backend=backend if backend is not None
+                    else self.backend)
             except LedgerError as e:
                 rejected.append((tx, e))
                 continue
@@ -165,6 +175,34 @@ class Mempool:
         if observing:
             _ADMIT_SECS.observe(_now() - t0)
         return added, rejected
+
+    async def try_add_txs_async(self, txs: Sequence[Any]
+                                ) -> tuple[list, list]:
+        """try_add_txs with the witness crypto routed through the
+        attached VerifyService (ROADMAP item 3: the batch-of-1 firehose
+        coalesced into device batches across ALL submitting threads).
+
+        Each tx's proofs (rules.tx_proofs) are verified through the
+        service first — blocking on back-pressure like any other caller
+        — then the synchronous admission runs with those verdicts
+        honored via a PrecheckedBackend, so a verdict is never computed
+        twice and admission semantics (capacity, duplicates, ordering)
+        are IDENTICAL to the direct path.  Degrades to plain
+        try_add_txs when no service is attached or the ledger does not
+        expose tx-level proofs."""
+        if self.verify_service is None:
+            return self.try_add_txs(txs)
+        reqs: list = []
+        for tx in txs:
+            p = self.rules.tx_proofs(self._state, tx)
+            if p is None:                    # ledger can't pre-extract:
+                return self.try_add_txs(txs)  # plain path for the batch
+            reqs.extend(p)
+        from ..crypto.batching import PrecheckedBackend, verdict_map
+        verdicts = await verdict_map(self.verify_service, reqs)
+        return self.try_add_txs(
+            txs, backend=PrecheckedBackend(
+                self.backend or _default_backend(), verdicts))
 
     def remove_txs(self, txids: Sequence[bytes]) -> None:
         """Drop the named txs and revalidate the remainder (removeTxs)."""

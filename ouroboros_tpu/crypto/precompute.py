@@ -41,6 +41,7 @@ carry private unregistered counters with the same API.
 """
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 
 import numpy as np
@@ -52,6 +53,38 @@ from ..observe import spans as _spans
 # reporting known=False for them without re-dispatching the fill kernel
 _BAD = object()
 _MISSING = object()
+
+
+class _Stripe:
+    """One namespace's lock with contention accounting.
+
+    Pre-service, the cache relied on GIL-atomic dict ops plus
+    best-effort LRU bookkeeping for exactly TWO concurrent threads (the
+    pipelined replay's producer/consumer).  The adaptive batching
+    service multiplies the submitter count, so the LRU bookkeeping now
+    runs under a real lock — ONE PER NAMESPACE (points / KES hash
+    paths), so Ed25519-key traffic never waits behind a KES walk.  The
+    device fill itself stays OUTSIDE the stripe: a multi-second kernel
+    dispatch must not serialize every other submitter's lookups.
+
+    Contention is measured, not guessed: a non-immediate acquire bumps
+    the owner's `lock_wait` counter (`precompute.lock_wait` in the
+    registry) before blocking."""
+
+    __slots__ = ("_lock", "_owner")
+
+    def __init__(self, owner: "PrecomputeCache"):
+        self._lock = threading.Lock()
+        self._owner = owner
+
+    def __enter__(self) -> "_Stripe":
+        if not self._lock.acquire(blocking=False):
+            self._owner.lock_wait += 1
+            self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._lock.release()
 
 
 class PrecomputeCache:
@@ -86,10 +119,20 @@ class PrecomputeCache:
         # bench/tests, counted whether or not observation is enabled —
         # and only the process-wide cache binds them into the global
         # registry (per-instance caches in tests stay private).
-        mk = ((lambda n: _metrics.counter(n, always=True)) if register
-              else (lambda n: _metrics.Counter(n, always=True)))
+        mk = ((lambda n, **kw: _metrics.counter(n, always=True, **kw))
+              if register
+              else (lambda n, **kw: _metrics.Counter(n, always=True, **kw)))
         self._counters = {name: mk(f"precompute.{name}")
                           for name in self._COUNTERS}
+        # lock contention is timing-shaped (how often two submitters
+        # collide), so unlike the functional counters it is excluded
+        # from the deterministic snapshot (stable=False)
+        self._counters["lock_wait"] = mk("precompute.lock_wait",
+                                         stable=False)
+        # per-namespace lock striping: point entries and KES hash-path
+        # outcomes contend independently
+        self._lock_c = _Stripe(self)
+        self._lock_kes = _Stripe(self)
 
     # -- counter aliases (the pre-registry accessor names, kept) ------------
     def _alias(name):  # noqa: N805 — descriptor factory, not a method
@@ -105,6 +148,7 @@ class PrecomputeCache:
     device_fills = _alias("device_fills")
     filled_keys = _alias("filled_keys")
     evictions = _alias("evictions")
+    lock_wait = _alias("lock_wait")
     del _alias
 
     def __len__(self):
@@ -120,20 +164,21 @@ class PrecomputeCache:
         # below must still see them (results stay correct under ANY bound)
         local: dict = {}
         missing = []
-        for vk in vks:
-            if vk in local:
-                continue
-            ent = self._c.get(vk, _MISSING)
-            if ent is not _MISSING:
-                try:                    # recency touch is best-effort: a
-                    self._c.move_to_end(vk)   # concurrent eviction (the
-                except KeyError:        # pipelined replay's other thread)
-                    pass                # may have dropped vk already
-                self.hits += 1
-                local[vk] = ent
-            else:
-                missing.append(vk)
-                local[vk] = _BAD       # overwritten by the fill below
+        with self._lock_c:
+            for vk in vks:
+                if vk in local:
+                    continue
+                ent = self._c.get(vk, _MISSING)
+                if ent is not _MISSING:
+                    try:                # recency touch stays best-effort
+                        self._c.move_to_end(vk)   # (eviction-tolerant:
+                    except KeyError:    # an unlocked legacy caller may
+                        pass            # still race the bookkeeping)
+                    self.hits += 1
+                    local[vk] = ent
+                else:
+                    missing.append(vk)
+                    local[vk] = _BAD   # overwritten by the fill below
         self.misses += len(missing)
         if missing:
             local.update(self._fill(missing))
@@ -195,16 +240,17 @@ class PrecomputeCache:
     def kes_get(self, key):
         """(leaf_vk, path_ok) for a hash-path identity (kes.hash_path_key),
         or None on first sighting."""
-        ent = self._kes.get(key)
-        if ent is None:
-            self.misses += 1
-            return None
-        try:                        # best-effort recency touch (see
-            self._kes.move_to_end(key)   # assemble: the consumer thread
-        except KeyError:            # may evict concurrently)
-            pass
-        self.hits += 1
-        return ent
+        with self._lock_kes:
+            ent = self._kes.get(key)
+            if ent is None:
+                self.misses += 1
+                return None
+            try:                    # best-effort recency touch kept
+                self._kes.move_to_end(key)   # (eviction-tolerant under
+            except KeyError:        # any unlocked legacy caller)
+                pass
+            self.hits += 1
+            return ent
 
     def kes_put(self, key, leaf_vk, path_ok: bool) -> None:
         self._insert(self._kes, key, (leaf_vk, bool(path_ok)))
@@ -214,20 +260,22 @@ class PrecomputeCache:
 
     # -- plumbing ------------------------------------------------------------
     def _insert(self, od: OrderedDict, key, value) -> None:
-        # every step tolerates a concurrent _insert from the pipelined
-        # replay's other thread (dict ops are GIL-atomic; only the LRU
-        # bookkeeping can observe a key another thread just evicted)
-        od[key] = value
-        try:
-            od.move_to_end(key)
-        except KeyError:
-            pass
-        while len(od) > self.max_entries:
+        # under the namespace stripe; every step STILL tolerates a
+        # concurrent mutation (the eviction-tolerant semantics from the
+        # pipelined-replay era are kept — dict ops are GIL-atomic and a
+        # legacy unlocked caller must not corrupt the LRU bookkeeping)
+        with (self._lock_c if od is self._c else self._lock_kes):
+            od[key] = value
             try:
-                od.popitem(last=False)
+                od.move_to_end(key)
             except KeyError:
-                break
-            self.evictions += 1
+                pass
+            while len(od) > self.max_entries:
+                try:
+                    od.popitem(last=False)
+                except KeyError:
+                    break
+                self.evictions += 1
 
     def clear(self) -> None:
         self._c.clear()
@@ -238,7 +286,8 @@ class PrecomputeCache:
                 "hits": self.hits, "misses": self.misses,
                 "device_fills": self.device_fills,
                 "filled_keys": self.filled_keys,
-                "evictions": self.evictions}
+                "evictions": self.evictions,
+                "lock_wait": self.lock_wait}
 
 
 # one process-wide cache: every backend instance (single-chip, sharded)

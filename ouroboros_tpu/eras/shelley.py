@@ -1110,6 +1110,11 @@ class ShelleyLedger(LedgerRules):
             raise LedgerError(f"tx {tx.txid.hex()[:12]}: bad witness")
         return replace(self._apply_txs(state, blk), tip=state.tip)
 
+    def tx_proofs(self, state: ShelleyLedgerState, tx: ShelleyTx) -> list:
+        """One tx's witness obligations (the batching-service admission
+        seam): same requests apply_tx verifies inline."""
+        return self.extract_proofs(state, _OneTxBlock(tx, state.tip))
+
 
 class _OneTxBlock:
     """Body-only pseudo-block anchored at an existing tip point so

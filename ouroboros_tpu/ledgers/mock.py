@@ -218,5 +218,11 @@ class MockLedger(LedgerRules):
         new = self._apply_txs(state, blk)
         return MockLedgerState(new.utxo, state.slot, state.tip)
 
+    def tx_proofs(self, state: MockLedgerState, tx: Tx) -> list:
+        """One tx's witness obligations (the batching-service admission
+        seam): same requests apply_tx would verify inline."""
+        return [Ed25519Req(vk=vk, msg=tx.txid, sig=sig)
+                for vk, sig in tx.witnesses]
+
     def ledger_view(self, state: MockLedgerState):
         return None

@@ -126,21 +126,38 @@ async def chain_sync_client(session, kernel, candidate: CandidateState,
 
     buffered: list = []          # validated-pending roll-forward headers
 
-    def flush() -> None:
+    async def flush() -> None:
         """Validate `buffered` as one batched window and publish.
 
         Views are forecast at each header's slot (cross-era aware); when
         the forecast horizon is hit the validated prefix is published and
         the rest stays buffered until the chain advances (the reference's
-        forecast-horizon waiting, Client.hs:~740-790)."""
+        forecast-horizon waiting, Client.hs:~740-790).
+
+        A sub-window flush — the caught-up batch-of-1 regime — routes
+        its proofs through the kernel's VerifyService when one is wired
+        (crypto/batching.py): the window's handful of proofs coalesces
+        with every other protocol thread's traffic into one device batch
+        (or takes the CPU break-even fallback) instead of dispatching
+        alone.  Full windows keep the direct batched path: they already
+        ARE a good device batch."""
         if not buffered:
             return
         _FLUSH_HEADERS.observe(len(buffered))
         from ouroboros_tpu.consensus.ledger import OutsideForecastRange
-        res = validate_headers_batched(
-            protocol, buffered, history.current,
-            lambda i, h: kernel.forecast_view(h.slot),
-            backend=kernel.backend)
+        svc = getattr(kernel, "verify_service", None)
+        if svc is not None and len(buffered) < window:
+            from ouroboros_tpu.crypto.batching import (
+                validate_headers_coalesced,
+            )
+            res = await validate_headers_coalesced(
+                protocol, buffered, history.current,
+                lambda i, h: kernel.forecast_view(h.slot), svc)
+        else:
+            res = validate_headers_batched(
+                protocol, buffered, history.current,
+                lambda i, h: kernel.forecast_view(h.slot),
+                backend=kernel.backend)
         for st, h in zip(res.states, buffered[:res.n_valid]):
             history.append(st)
             fragment.add_block(h)
@@ -192,7 +209,7 @@ async def chain_sync_client(session, kernel, candidate: CandidateState,
             ready = await session.channel.wait_ready(0.2)
             horizon_stalled[0] = False
             if not ready:
-                flush()
+                await flush()
                 continue
         msg = await collect_with_limit(session, limits,
                                        peer_id=candidate.peer_id)
@@ -200,7 +217,7 @@ async def chain_sync_client(session, kernel, candidate: CandidateState,
             # caught up: validate what we have, then wait for the next
             # server push (the collect below blocks on the channel)
             caught_up[0] = True
-            flush()
+            await flush()
             continue
         if isinstance(msg, MsgRollForward):
             if _metrics.enabled():
@@ -211,13 +228,13 @@ async def chain_sync_client(session, kernel, candidate: CandidateState,
             buffered.append(msg.header)
             _note_tip(msg.tip)
             if len(buffered) >= window:
-                flush()
+                await flush()
             elif session.outstanding == 0:
-                flush()
+                await flush()
             continue
         if isinstance(msg, MsgRollBackward):
             _note_tip(msg.tip)
-            flush()
+            await flush()
             if not history.rewind(msg.point):
                 raise ChainSyncClientError(
                     f"peer rolled back beyond k to {msg.point}")

@@ -60,7 +60,8 @@ class NodeKernel:
                  btime: BlockchainTime, forgings=(), label: str = "node",
                  backend=None, chain_sync_window: int = 32,
                  header_decode=None, block_decode_obj=None, tx_decode=None,
-                 tracers=None, time_limits: Optional[NodeTimeLimits] = None):
+                 tracers=None, time_limits: Optional[NodeTimeLimits] = None,
+                 verify_service=None):
         from ..utils.tracer import NodeTracers
         self.chain_db = chain_db
         self.ledger_rules = ledger_rules
@@ -70,6 +71,14 @@ class NodeKernel:
         self.forgings = list(forgings)
         self.label = label
         self.backend = backend
+        # adaptive batching service (crypto/batching.py): when attached,
+        # sub-window ChainSync flushes (the caught-up batch-of-1 regime)
+        # and mempool admission coalesce their proofs through it instead
+        # of dispatching alone
+        self.verify_service = verify_service
+        if mempool is not None and verify_service is not None \
+                and mempool.verify_service is None:
+            mempool.verify_service = verify_service
         self.chain_sync_window = chain_sync_window
         self.header_decode = header_decode
         self.block_decode_obj = block_decode_obj

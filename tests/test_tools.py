@@ -143,14 +143,33 @@ def test_bench_smoke_parity_gate():
     sh = res["sharded_replay_smoke"]
     assert sh["ok"] is True
     assert sh.get("skipped") or sh["producer_threads_leaked"] == 0
+    # ISSUE 12: the verification-service serve probe (seeded bursty sim
+    # traces through the adaptive micro-batching coalescer) — >=5x the
+    # unbatched per-request CPU baseline at saturation with p95 inside
+    # the deadline, CPU fallback with ZERO device dispatches under
+    # light load, back-pressure contract honored, byte-identical
+    # verdicts and zero leaked sim threads on every leg
+    sv = res["serve_probe"]
+    assert sv["ok"] is True
+    assert sv["saturated"]["vs_unbatched_cpu"] >= 5.0
+    assert sv["saturated"]["p95_within_deadline"] is True
+    assert sv["saturated"]["parity"] is True
+    assert sv["light_load"]["device_batches"] == 0
+    assert sv["light_load"]["parity"] is True
+    assert sv["backpressure"]["backpressure_waits"] > 0
+    assert sv["backpressure"]["parity"] is True
+    for leg in ("saturated", "light_load", "backpressure"):
+        assert sv[leg]["leaked_threads"] == 0
     assert res["blocks"] == 8
 
 
 def test_bench_cli_flags_exist():
-    """--smoke/--retune are wired (driver + CI call them blind)."""
+    """--smoke/--retune/--serve are wired (driver + CI call them
+    blind)."""
     r = _run("bench.py", "--help")
     assert r.returncode == 0, r.stderr
     assert "--smoke" in r.stdout and "--retune" in r.stdout
+    assert "--serve" in r.stdout
 
 
 # ---------------------------------------------------------------------------
@@ -213,6 +232,26 @@ def test_perfgate_single_check_failure_and_thresholds(tmp_path):
     r2 = _run("-m", "tools.perfgate", "--max-spread", "0.7",
               "--check", *paths)
     assert r2.returncode == 0, r2.stdout
+
+
+def test_perfgate_tightened_spread_binds_from_r06(tmp_path):
+    """ISSUE 12 satellite: the rep-spread bound tightened 0.45 -> 0.35
+    now that the GC-discipline fix (PR 8) and the ('vrff', m) autotune
+    key (PR 11) landed.  A 0.40-spread r06 — fine under the old bound —
+    fails; the committed r01-r05 history stays tolerated (the legacy
+    bound applies to rounds predating the variance fixes)."""
+    paths = _regressed_round(tmp_path, vs_baseline=13.0, spread=0.40)
+    r = _run("-m", "tools.perfgate", "--check", *paths)
+    assert r.returncode == 1, r.stdout + r.stderr
+    results = {c["check"]: c["result"]
+               for c in json.loads(r.stdout)["checks"]}
+    assert results["rep_spread"] == "FAIL"
+    assert results["vs_baseline"] == "pass"
+    # history alone (latest = r05) still passes under the legacy bound
+    import glob
+    rounds = sorted(glob.glob(os.path.join(REPO, "BENCH_r0*.json")))
+    r2 = _run("-m", "tools.perfgate", "--check", *rounds)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
 
 
 def test_perfgate_unreadable_input_is_rc2(tmp_path):
@@ -378,6 +417,64 @@ def test_obsreport_renders_overlap_section(tmp_path):
     r = _run("-m", "tools.obsreport", "BENCH_r05.json")
     assert r.returncode == 0
     assert "no 'overlap' section" in r.stdout
+
+
+def test_obsreport_renders_serve_section(tmp_path):
+    """ISSUE 12 satellite: a round carrying the ``serve`` section (the
+    adaptive batching service bench) renders the latency-quantile
+    table, the coalesced-batch-size histogram and the fallback /
+    deadline-miss / back-pressure accounting."""
+    doc = {
+        "metric": "verify_service_serve", "value": 6300.0,
+        "unit": "proofs/s",
+        "serve": {
+            "seed": 7, "deadline_secs": 0.05, "modeled_costs": True,
+            "break_even": {"device_kind": "modeled-device",
+                           "entries": {"ed25519": {
+                               "n_star": 3, "cpu_secs_per_req": 1e-3,
+                               "device_secs_batch": 0.00712,
+                               "bucket": 256}}},
+            "saturated": {
+                "requests": 2000, "proofs_per_sec": 6300.0,
+                "cpu_unbatched_proofs_per_sec": 1000.0,
+                "vs_unbatched_cpu": 6.3,
+                "latency": {"p50": 0.026, "p95": 0.045, "p99": 0.051},
+                "cpu_unbatched_latency": {"p50": 1.62, "p95": 3.26,
+                                          "p99": 3.40},
+                "p95_within_deadline": True, "deadline_misses": 45,
+                "deadline_miss_frac": 0.011,
+                "batch_size_hist": {"256": 7, "180": 1},
+                "service": {"device_batches": 57,
+                            "device_requests": 2000,
+                            "fallback_batches": 0,
+                            "fallback_requests": 0},
+                "parity": True, "leaked_threads": 0},
+            "light_load": {"requests": 21, "break_even_n": 3,
+                           "device_batches": 0,
+                           "fallback_requests": 21, "parity": True,
+                           "leaked_threads": 0},
+            "backpressure": {"requests": 198, "max_queue": 32,
+                             "backpressure_waits": 166,
+                             "completed": 198, "parity": True,
+                             "leaked_threads": 0},
+        },
+    }
+    p = tmp_path / "serve.json"
+    p.write_text(json.dumps(doc))
+    r = _run("-m", "tools.obsreport", str(p))
+    assert r.returncode == 0, r.stderr
+    assert "verification service" in r.stdout
+    assert "6.3x the unbatched per-request CPU baseline" in r.stdout
+    assert "p95 within deadline: True" in r.stdout
+    assert "coalesced batch sizes" in r.stdout
+    assert "device batches 0" in r.stdout          # light-load line
+    assert "166 blocked submits" in r.stdout
+    assert "verdict parity vs CpuRefBackend on every leg: True" \
+        in r.stdout
+    # a round without the section renders unchanged
+    r2 = _run("-m", "tools.obsreport", "BENCH_r05.json")
+    assert r2.returncode == 0
+    assert "verification service" not in r2.stdout
 
 
 def test_obsreport_live_flag_wired():

@@ -153,6 +153,12 @@ def render(doc: dict) -> str:
         out.append("no 'overlap' section (round predates the threaded "
                    "producer/consumer replay attribution)")
 
+    # -- verification-service serve section (ISSUE 12) ----------------------
+    serve = doc.get("serve")
+    if serve:
+        out.append("")
+        out += _render_serve(serve)
+
     # -- precompute cache ---------------------------------------------------
     out.append("")
     pc = doc.get("precompute")
@@ -178,6 +184,70 @@ def render(doc: dict) -> str:
     else:
         out.append("no 'metrics' section")
     return "\n".join(out) + "\n"
+
+
+def _render_serve(serve: dict) -> List[str]:
+    """The ``serve`` section of a bench round (ISSUE 12): request-latency
+    quantiles of the coalescing service vs the unbatched CPU baseline,
+    the coalesced-batch-size histogram, and the fallback / deadline-miss
+    / back-pressure accounting across the three trace legs."""
+    out: List[str] = []
+    sat = serve.get("saturated") or {}
+    out.append(f"verification service (seed {serve.get('seed', '?')}, "
+               f"deadline {serve.get('deadline_secs', '?')}s"
+               + (", modeled device costs" if serve.get("modeled_costs")
+                  else ", measured device costs") + "):")
+    if sat:
+        out.append(f"  saturated: {sat.get('requests')} requests, "
+                   f"{sat.get('proofs_per_sec')} proofs/s = "
+                   f"{sat.get('vs_unbatched_cpu')}x the unbatched "
+                   f"per-request CPU baseline "
+                   f"({sat.get('cpu_unbatched_proofs_per_sec')} /s)")
+        lq, cq = sat.get("latency") or {}, \
+            sat.get("cpu_unbatched_latency") or {}
+        rows = [["service", lq.get("p50", "-"), lq.get("p95", "-"),
+                 lq.get("p99", "-")],
+                ["cpu unbatched", cq.get("p50", "-"), cq.get("p95", "-"),
+                 cq.get("p99", "-")]]
+        out += _table(rows, ["request latency (s)", "p50", "p95", "p99"])
+        within = sat.get("p95_within_deadline")
+        out.append(f"  p95 within deadline: {within}; deadline misses "
+                   f"{sat.get('deadline_misses')} "
+                   f"({sat.get('deadline_miss_frac')})")
+        hist = sat.get("batch_size_hist") or {}
+        if hist:
+            out.append("  coalesced batch sizes (size: flushes):")
+            out += _table([[k, hist[k]] for k in
+                           sorted(hist, key=lambda s: int(s))],
+                          ["batch", "count"])
+        svc = sat.get("service") or {}
+        out.append(f"  device batches {svc.get('device_batches')} "
+                   f"({svc.get('device_requests')} reqs) / CPU fallback "
+                   f"{svc.get('fallback_batches')} "
+                   f"({svc.get('fallback_requests')} reqs)")
+    light = serve.get("light_load") or {}
+    if light:
+        out.append(f"  light load: {light.get('requests')} requests, "
+                   f"device batches {light.get('device_batches')} "
+                   f"(break-even n*={light.get('break_even_n')}; 0 = "
+                   f"every flush took the CPU fallback), "
+                   f"{light.get('fallback_requests')} fallback reqs")
+    bp = serve.get("backpressure") or {}
+    if bp:
+        out.append(f"  back-pressure: {bp.get('requests')} requests vs "
+                   f"queue {bp.get('max_queue')}: "
+                   f"{bp.get('backpressure_waits')} blocked submits, "
+                   f"{bp.get('completed')} completed")
+    be = (serve.get("break_even") or {}).get("entries") or {}
+    if be:
+        rows = [[p, be[p].get("n_star"), be[p].get("cpu_secs_per_req"),
+                 be[p].get("device_secs_batch")] for p in sorted(be)]
+        out += _table(rows, ["primitive", "n*", "cpu s/req",
+                             "device s/batch"])
+    parity = all(leg.get("parity") for leg in (sat, light, bp) if leg)
+    out.append(f"  verdict parity vs CpuRefBackend on every leg: "
+               f"{parity}")
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -63,7 +63,14 @@ from typing import List, Optional
 from tools.obsreport import load_bench
 
 DEFAULT_MAX_DROP = 0.25
-DEFAULT_MAX_SPREAD = 0.45
+# rep-spread bound tightened 0.45 -> 0.35 (ISSUE 12): the GC-discipline
+# fix (PR 8) and the ("vrff", m) autotune key (PR 11) removed the two
+# known variance sources, so a 0.40-spread round is a regression again.
+# Historic rounds were measured before those fixes and stay judged by
+# the old bound — the tight one binds from r06 on.
+DEFAULT_MAX_SPREAD = 0.35
+LEGACY_MAX_SPREAD = 0.45
+SPREAD_BINDS_FROM_ROUND = 6
 DEFAULT_MIN_HIDDEN_FRAC = 0.25
 
 
@@ -124,9 +131,19 @@ def check_trajectory(paths: List[str],
     if latest["spread"] is None:
         check("rep_spread", None, "no 'spread' field in latest round")
     else:
-        check("rep_spread", latest["spread"] <= max_spread,
+        # rounds measured before the r06 variance fixes are judged by
+        # the legacy bound; the caller's (tighter) bound binds after
+        rnd = latest["round"]
+        bound = max_spread
+        note = ""
+        if rnd is not None and rnd < SPREAD_BINDS_FROM_ROUND:
+            bound = max(max_spread, LEGACY_MAX_SPREAD)
+            note = (f" (legacy bound: r{rnd:02d} predates the "
+                    f"variance fixes; {max_spread} binds from "
+                    f"r{SPREAD_BINDS_FROM_ROUND:02d})")
+        check("rep_spread", latest["spread"] <= bound,
               f"latest rep spread {latest['spread']} vs allowed "
-              f"{max_spread}")
+              f"{bound}{note}")
 
     if latest["hidden_frac"] is None:
         check("hidden_frac", None,
