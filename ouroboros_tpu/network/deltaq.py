@@ -10,6 +10,17 @@ probes (KeepAlive.hs:41-55) and mux SDU timestamps
 from __future__ import annotations
 
 from dataclasses import dataclass, replace
+from typing import Optional
+
+from ..observe import metrics as _metrics
+from ..observe import netmetrics as _net
+
+# per-protocol round-trip latency (ISSUE 14): the KeepAlive probe is the
+# protocol that measures a true RTT; BlockFetch/handshake request
+# latencies live beside it under the same net.rtt.* namespace (bound in
+# node/block_fetch.py and node/kernel.py).  Handles pre-bound (OBS002).
+_RTT_KEEPALIVE = _metrics.latency_histogram("net.rtt.keepalive_secs")
+_OWD_SECS = _metrics.latency_histogram("net.deltaq.owd_secs")
 
 
 @dataclass(frozen=True)
@@ -47,15 +58,38 @@ class PeerGSVTracker:
     size fit for S (TraceStats.hs accumulates per-SDU samples the same
     way: min one-way-delay as the G estimate, deviations as V)."""
 
-    def __init__(self, alpha: float = 0.2):
+    def __init__(self, alpha: float = 0.2,
+                 label: Optional[str] = None):
         self.alpha = alpha
         self.gsv = PeerGSV()
         self._rtt_count = 0
         self._owd_count = 0
+        # when labelled, every accepted sample publishes the inbound GSV
+        # estimate as per-peer gauges (net.deltaq.{g,s,v}) through the
+        # bounded-label helper — live DeltaQ state on the scrape endpoint
+        self._label = label
+        self._gauges = None
+
+    def _publish(self) -> None:
+        if self._label is None or not _metrics.REGISTRY.enabled:
+            return
+        g = self._gauges
+        if g is None:
+            peer = _net.peer_label(self._label)
+            g = self._gauges = (
+                _net.labeled_gauge("net.deltaq.g_secs", peer=peer),
+                _net.labeled_gauge("net.deltaq.s_secs_per_byte",
+                                   peer=peer),
+                _net.labeled_gauge("net.deltaq.v_secs", peer=peer))
+        inn = self.gsv.inbound
+        g[0].set(inn.g)
+        g[1].set(inn.s)
+        g[2].set(inn.v)
 
     def observe_rtt(self, rtt: float) -> None:
         """A KeepAlive round-trip for a tiny payload: attribute half to
         each direction's G (the probe body is ~bytes, S negligible)."""
+        _RTT_KEEPALIVE.observe(rtt)
         half = rtt / 2.0
         self._rtt_count += 1
         out, inn = self.gsv.outbound, self.gsv.inbound
@@ -63,10 +97,12 @@ class PeerGSVTracker:
             # keep a better inbound G already learned from SDU timestamps
             in_g = min(inn.g, half) if self._owd_count else half
             self.gsv = PeerGSV(replace(out, g=half), replace(inn, g=in_g))
+            self._publish()
             return
         new_out = self._update_dir(out, half)
         new_in = self._update_dir(inn, half)
         self.gsv = PeerGSV(new_out, new_in)
+        self._publish()
 
     def _update_dir(self, d: GSV, sample_g: float) -> GSV:
         g = min(d.g, sample_g)
@@ -93,6 +129,8 @@ class PeerGSVTracker:
         self.gsv = PeerGSV(self.gsv.outbound,
                            replace(inn, g=g, v=v, s=s))
         self._owd_count += 1
+        _OWD_SECS.observe(owd)
+        self._publish()
 
     def observe_transfer(self, nbytes: int, duration: float) -> None:
         """A sized inbound transfer (a BlockFetch batch): refine S as the
@@ -103,6 +141,7 @@ class PeerGSVTracker:
         s_sample = max(0.0, (duration - inn.g) / nbytes)
         s = min(inn.s, s_sample) if self._rtt_count else s_sample
         self.gsv = PeerGSV(self.gsv.outbound, replace(inn, s=s))
+        self._publish()
 
     @property
     def measured(self) -> bool:

@@ -17,6 +17,7 @@ from typing import Optional
 
 from .. import simharness as sim
 from ..observe import metrics as _metrics
+from ..observe import netmetrics as _net
 from ..simharness import TBQueue, TVar, retry
 
 _TEARDOWNS = _metrics.counter("mux.teardowns")
@@ -179,6 +180,17 @@ class Mux:
         # bumped on channel registration so the egress loop's STM retry
         # re-reads the channel set (a snapshot would miss late channels)
         self._chan_version = TVar(0, label=f"{label}.chanver")
+        # per-peer traffic accounting (ISSUE 14), built lazily on the
+        # first ENABLED write: with observation off the per-SDU cost is
+        # exactly one flag read — no label formatting, no instrument
+        # writes (the bench --smoke disabled-observation probe)
+        self._io: Optional[_net.MuxIO] = None
+
+    def _io_acct(self) -> _net.MuxIO:
+        io = self._io
+        if io is None:
+            io = self._io = _net.MuxIO(self.label)
+        return io
 
     def channel(self, num: int, mode: int) -> MuxChannel:
         key = (num, mode)
@@ -266,6 +278,8 @@ class Mux:
                     ts = int(sim.now() * 1e6) & 0xFFFFFFFF
                     await self.bearer.write(
                         SDU(ts, ch._mode, ch._num, chunk))
+                    if _metrics.REGISTRY.enabled:
+                        self._io_acct().egress(ch._num, len(chunk))
 
     async def _demux_loop(self):
         """Read SDUs, route to ingress queues; overflow kills the mux
@@ -289,6 +303,8 @@ class Mux:
     async def _demux_body(self):
         while True:
             sdu = await self.bearer.read()
+            if _metrics.REGISTRY.enabled:
+                self._io_acct().ingress(sdu.num, len(sdu.payload))
             if self.owd_observer is not None:
                 # 32-bit µs wraparound-safe one-way delay from the sender's
                 # RemoteClockModel timestamp (TraceStats.hs)

@@ -20,7 +20,18 @@ from dataclasses import dataclass, field, replace
 from typing import Callable, Dict, Optional, Sequence
 
 from .. import simharness as sim
+from ..observe import metrics as _metrics
 from ..simharness import Retry, TVar
+
+# governor churn counters (ISSUE 14): successful ladder transitions plus
+# failure-driven suspensions, pre-bound (OBS002).  Gated int bumps —
+# invisible to sim determinism.
+_PROMOTED_COLD = _metrics.counter("net.governor.promote_cold")
+_PROMOTED_WARM = _metrics.counter("net.governor.promote_warm")
+_DEMOTED_HOT = _metrics.counter("net.governor.demote_hot")
+_DEMOTED_WARM = _metrics.counter("net.governor.demote_warm")
+_CHURN_ROUNDS = _metrics.counter("net.governor.churn_rounds")
+_GOV_SUSPENSIONS = _metrics.counter("net.governor.suspensions")
 
 
 @dataclass(frozen=True)
@@ -244,6 +255,7 @@ class PeerSelectionGovernor:
         self.known.suspend(addr, sim.now() + backoff)
         self.established.discard(addr)
         self.active.discard(addr)
+        _GOV_SUSPENSIONS.inc()
         self.poke()
 
     async def _apply(self, d: Decision) -> None:
@@ -267,6 +279,7 @@ class PeerSelectionGovernor:
             ok = await self.actions.connect(d.addr)
             if ok:
                 self.established.add(d.addr)
+                _PROMOTED_COLD.inc()
                 info = self.known.peers.get(d.addr)
                 if info is not None:
                     info.fail_count = 0
@@ -275,14 +288,17 @@ class PeerSelectionGovernor:
         elif d.kind == PROMOTE_WARM:
             if await self.actions.activate(d.addr):
                 self.active.add(d.addr)
+                _PROMOTED_WARM.inc()
             else:
                 self.report_failure(d.addr)
         elif d.kind == DEMOTE_HOT:
             await self.actions.deactivate(d.addr)
             self.active.discard(d.addr)
+            _DEMOTED_HOT.inc()
         elif d.kind == DEMOTE_WARM:
             await self.actions.disconnect(d.addr)
             self.established.discard(d.addr)
+            _DEMOTED_WARM.inc()
 
     async def churn_round(self) -> Optional[object]:
         """One churn step (peerChurnGovernor, Governor.hs:557): demote a
@@ -292,6 +308,7 @@ class PeerSelectionGovernor:
         if not self.active:
             return None
         victim = self.rng.choice(sorted(self.active, key=str))
+        _CHURN_ROUNDS.inc()
         self.trace.append((sim.now(), "churn", victim))
         await self.actions.deactivate(victim)
         self.active.discard(victim)

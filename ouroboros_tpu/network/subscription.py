@@ -20,6 +20,7 @@ from typing import Callable, Dict, Optional, Sequence
 
 from .. import simharness as sim
 from ..observe import metrics as _metrics
+from ..observe import netmetrics as _net
 from .error_policy import ErrorPolicy, SuspendDecision, eval_error_policies
 
 # process-wide reconnect/suspension counters (ISSUE 7): the registry
@@ -269,6 +270,11 @@ class SubscriptionWorker:
         if verdict.kind == "suspend-peer":
             st.peer_until = max(st.peer_until, until)
         _SUSPENSIONS.inc()
+        if _metrics.REGISTRY.enabled:
+            # per-peer suspension attribution through the bounded-label
+            # helper; cold path (one write per connection death)
+            _net.labeled_counter("net.peer.suspensions",
+                                 peer=_net.peer_label(addr)).inc()
         self.trace.append((now, "conn-end", addr, repr(exc)))
         sim.trace_event((self.label, "suspend", addr, verdict.kind,
                          round(until - now, 6), st.fail_count),

@@ -19,7 +19,13 @@ from typing import Callable, Dict, Optional, Sequence
 from .. import simharness as sim
 from ..chain.block import Point, point_of
 from ..network.protocols.blockfetch import fetch_range
+from ..observe import metrics as _metrics
 from ..simharness import Retry, TQueue, TVar
+
+# per-request BlockFetch latency (ISSUE 14 net.rtt.* namespace, beside
+# the KeepAlive RTT in network/deltaq.py); handle pre-bound (OBS002)
+_FETCH_REQUEST_SECS = _metrics.latency_histogram(
+    "net.rtt.blockfetch_secs")
 
 
 @dataclass(frozen=True)
@@ -279,6 +285,7 @@ async def fetch_logic_loop(kernel) -> None:
     the decision pipeline whenever a candidate, the current chain, or the
     in-flight set changes, and enqueues requests to per-peer clients."""
     from ..utils.tracer import TraceFetchDecision
+    prop = getattr(kernel, "propagation", None)
     while True:
         seen = kernel.fetch_wakeup.value
         # fetch MODE (BlockFetchConsensusInterface readFetchMode): far
@@ -302,6 +309,9 @@ async def fetch_logic_loop(kernel) -> None:
             ps = kernel.peer_fetch[req.peer_id]
             ps.in_flight |= {h.hash for h in req.headers}
             ps.in_flight_bytes += req.est_bytes
+            if prop is not None:
+                for h in req.headers:
+                    prop.mark("fetch_decided", h.hash, peer=req.peer_id)
             if kernel.tracers.fetch.active:
                 kernel.tracers.fetch.trace(TraceFetchDecision(
                     peer_id=req.peer_id, n_requested=len(req.headers),
@@ -327,6 +337,7 @@ async def block_fetch_client(session, kernel, peer_id) -> None:
     block every other peer from ever re-requesting that chain segment."""
     from .watchdog import WatchdogTimeout
     ps = kernel.peer_fetch[peer_id]
+    prop = getattr(kernel, "propagation", None)
     try:
         while True:
             req = await sim.atomically(lambda tx: ps.queue.get(tx))
@@ -347,10 +358,13 @@ async def block_fetch_client(session, kernel, peer_id) -> None:
                 tracker = kernel.peer_gsv.get(peer_id)
                 if blocks:
                     total = sum(len(b.bytes) for b in blocks)
+                    _FETCH_REQUEST_SECS.observe(sim.now() - t0)
                     if tracker is not None:
                         tracker.observe_transfer(total, sim.now() - t0)
                     ps.observe_blocks(len(blocks), total)
                 for b in blocks or ():
+                    if prop is not None:
+                        prop.mark("body_arrived", b.hash, peer=peer_id)
                     kernel.add_fetched_block(b)
             finally:
                 ps.in_flight -= {h.hash for h in req.headers}

@@ -92,6 +92,9 @@ async def chain_sync_client(session, kernel, candidate: CandidateState,
     # per-state time limits (timeLimitsChainSync): a peer silent past its
     # state's deadline is killed via WatchdogTimeout -> ErrorPolicy
     limits = kernel.time_limits.chain_sync()
+    # block-propagation lifecycle tracker (ISSUE 14): records
+    # first-header-seen / validated stamps when the kernel carries one
+    prop = getattr(kernel, "propagation", None)
 
     # -- find intersection with our current chain ----------------------------
     points = db.current_chain.select_points(_OFFSETS)
@@ -161,6 +164,8 @@ async def chain_sync_client(session, kernel, candidate: CandidateState,
         for st, h in zip(res.states, buffered[:res.n_valid]):
             history.append(st)
             fragment.add_block(h)
+            if prop is not None:
+                prop.mark("validated", h.hash, peer=candidate.peer_id)
         del buffered[:res.n_valid]
         if res.n_valid:
             if kernel.tracers.chain_sync.active:
@@ -225,6 +230,9 @@ async def chain_sync_client(session, kernel, candidate: CandidateState,
                 if last_arrival[0] is not None:
                     _ARRIVAL_GAP.observe(now - last_arrival[0])
                 last_arrival[0] = now
+            if prop is not None:
+                prop.mark("header_seen", msg.header.hash,
+                          peer=candidate.peer_id)
             buffered.append(msg.header)
             _note_tip(msg.tip)
             if len(buffered) >= window:

@@ -352,7 +352,7 @@ class GovernedConnection:
         )
         from ..network.mux import bearer_pair
         bi, br = bearer_pair(sdu_size=self.sdu_size, delay=self.link_delay)
-        tracker = PeerGSVTracker()
+        tracker = PeerGSVTracker(label=self.peer_id)
         self.mux_i = Mux(bi, f"{self.peer_id}.mux-i",
                          owd_observer=tracker.observe_owd)
         self.mux_r = Mux(br, f"{self.peer_id}.mux-r")

@@ -28,6 +28,7 @@ from ..network.protocols import handshake as hs_proto
 from ..network.protocols import keepalive as ka_proto
 from ..network.protocols import txsubmission as tx_proto
 from ..network.typed import CLIENT, PipelinedSession, SERVER, Session
+from ..observe import metrics as _metrics
 from ..simharness import TVar
 from .block_fetch import (
     PeerFetchState, block_fetch_client, block_fetch_server, fetch_logic_loop,
@@ -41,6 +42,9 @@ from .watchdog import KeepAliveTimeout, NodeTimeLimits, WatchdogTimeout
 # protocol numbers per NodeToNode.hs:211-212 (handshake=0, chainsync=2,
 # blockfetch=3, txsubmission=4, keepalive=8)
 CHAINSYNC_NUM, BLOCKFETCH_NUM, TXSUBMISSION_NUM, KEEPALIVE_NUM = 2, 3, 4, 8
+
+# whole-negotiation latency (the net.rtt.* namespace, ISSUE 14)
+_HANDSHAKE_SECS = _metrics.latency_histogram("net.rtt.handshake_secs")
 
 
 @dataclass
@@ -89,6 +93,10 @@ class NodeKernel:
         self.candidates: Dict[object, CandidateState] = {}
         self.peer_fetch: Dict[object, PeerFetchState] = {}
         self.peer_gsv: Dict[object, PeerGSVTracker] = {}
+        # block-propagation lifecycle tracker (observe/propagation.py):
+        # attached by the fleet harness (threadnet) or an operator; None
+        # = zero per-block bookkeeping
+        self.propagation = None
         self.keepalive_interval = 10.0
         # per-state protocol watchdogs (timeLimits*; node/watchdog.py)
         self.time_limits = time_limits if time_limits is not None \
@@ -109,6 +117,13 @@ class NodeKernel:
             self.chain_db.version_tvar.set_notify(self.chain_db.version)
         except Exception:
             self.chain_db.version_tvar._value = self.chain_db.version
+        prop = self.propagation
+        if prop is not None:
+            # stamp every newly adopted block (walk back from the head;
+            # the first already-stamped hash ends the new suffix)
+            for b in reversed(self.chain_db.current_chain.blocks):
+                if not prop.mark("adopted", b.hash):
+                    break
         if self.mempool is not None:
             self.mempool.sync_with_ledger()
         self.poke_fetch_logic()
@@ -315,8 +330,9 @@ def _connect_directional(initiator: NodeKernel, responder: NodeKernel,
         br = fault_plan.wrap_bearer(br, responder.label, initiator.label)
     # the initiator's GSV estimate for this peer is fed passively by the
     # demuxer's per-SDU one-way delays (TraceStats.hs) on top of the
-    # KeepAlive RTT probes
-    tracker = PeerGSVTracker()
+    # KeepAlive RTT probes; the label publishes the estimate as per-peer
+    # net.deltaq.* gauges through the bounded-label helper
+    tracker = PeerGSVTracker(label=peer_id)
     mux_i = Mux(bi, f"{tag}.mux-i", owd_observer=tracker.observe_owd)
     mux_r = Mux(br, f"{tag}.mux-r")
     mux_i.start()
@@ -449,9 +465,12 @@ async def _run_initiator(initiator: NodeKernel, mux_i, peer_id,
     # the whole negotiation runs under one deadline (the reference's
     # handshake timeout): a peer that swallows the proposal would
     # otherwise hang this dial forever while it holds a valency slot
+    t0 = sim.now()
     done, version = await sim.timeout(
         initiator.time_limits.handshake_timeout,
         _initiator_handshake(initiator, mux_i, peer_id))
+    if done and version is not None:
+        _HANDSHAKE_SECS.observe(sim.now() - t0)
     if not done:
         sim.trace_event(("timeout", "handshake", "StConfirm", peer_id),
                         label="watchdog")

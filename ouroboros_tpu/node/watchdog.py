@@ -30,16 +30,20 @@ from typing import Mapping, Optional
 
 from .. import simharness as sim
 from ..observe import metrics as _metrics
+from ..observe import netmetrics as _net
 
-# one firing counter for all watchdogs; per-protocol attribution stays
-# in the typed WatchdogTimeout / sim trace (names are few and static, so
-# a per-protocol counter is also kept, created at first firing)
+# one firing counter for all watchdogs; per-protocol attribution is a
+# labeled series through the bounded-label helper (the name carries a
+# runtime value, so it pays the same cardinality discipline as peer
+# labels — OBS003).  Cold path: a firing kills the connection, so it
+# happens at most once per peer lifetime.
 _FIRINGS = _metrics.counter("watchdog.firings")
 
 
 def _count_firing(protocol: str) -> None:
     _FIRINGS.inc()
-    _metrics.counter(f"watchdog.firings.{protocol}").inc()
+    _net.labeled_counter("watchdog.firings_by_protocol",
+                         protocol=protocol).inc()
 
 
 class WatchdogTimeout(Exception):

@@ -17,6 +17,12 @@ Three parts, one seam (ISSUE 7):
 - `flight`: the always-on flight recorder — a bounded ring of recent
   spans/events/metric deltas, dumped as chrome-trace + JSONL on failure
   (ISSUE 9).
+- `netmetrics`: bounded-cardinality per-peer network instruments — the
+  `peer_label` LRU helper, labeled counters/gauges, and the mux traffic
+  accounting (ISSUE 14).
+- `propagation`: per-node block-propagation lifecycle timelines + the
+  FleetTelemetry merge (time-to-adoption quantiles, per-edge delivery
+  latency, partition healing) for chaos-fleet runs (ISSUE 14).
 - `scrape` (imported on demand — it pulls the network stack): the live
   Prometheus scrape endpoint + periodic emitter over the project's own
   snocket/SDU transport.
@@ -33,10 +39,13 @@ observation that the flag may drop.
 """
 from __future__ import annotations
 
-from . import adapter, export, flight, metrics, spans
+from . import adapter, export, flight, metrics, netmetrics, propagation, \
+    spans
 from .adapter import counting_node_tracers, metrics_node_tracers
 from .flight import FLIGHT, FlightRecorder
 from .metrics import REGISTRY, Counter, Gauge, Histogram, MetricsRegistry
+from .netmetrics import peer_label
+from .propagation import FleetTelemetry, PropagationTracker
 from .spans import RECORDER, Span, SpanRecorder, phase_totals, span
 
 # NOTE: observe.scrape is deliberately NOT imported here — it pulls in
@@ -44,12 +53,12 @@ from .spans import RECORDER, Span, SpanRecorder, phase_totals, span
 # consumers `from ouroboros_tpu.observe import scrape` on demand.
 
 __all__ = [
-    "FLIGHT", "FlightRecorder",
+    "FLIGHT", "FleetTelemetry", "FlightRecorder",
     "REGISTRY", "RECORDER", "Counter", "Gauge", "Histogram",
-    "MetricsRegistry", "Span", "SpanRecorder",
+    "MetricsRegistry", "PropagationTracker", "Span", "SpanRecorder",
     "adapter", "counting_node_tracers", "disable", "enable", "enabled",
-    "export", "flight", "metrics", "metrics_node_tracers", "phase_totals",
-    "span", "spans",
+    "export", "flight", "metrics", "metrics_node_tracers", "netmetrics",
+    "peer_label", "phase_totals", "propagation", "span", "spans",
 ]
 
 

@@ -28,11 +28,23 @@ from .spans import Span
 PROM_PREFIX = "ouro_"
 
 
-def _prom_name(name: str) -> str:
+def _split_labels(name: str) -> tuple:
+    """(base, inner-label-text) for names carrying a `{k="v",...}` label
+    block (observe/netmetrics.py labeled instruments); ("name", "") for
+    plain names."""
+    if name.endswith("}") and "{" in name:
+        base, labels = name.split("{", 1)
+        return base, labels[:-1]
+    return name, ""
+
+
+def _mangle(base: str) -> str:
     out = []
-    for ch in name:
+    for ch in base:
         out.append(ch if (ch.isalnum() or ch == "_") else "_")
     return PROM_PREFIX + "".join(out)
+
+
 
 
 def _prom_num(v) -> str:
@@ -49,23 +61,34 @@ def prometheus_text(reg: MetricsRegistry,
     default — a scrape endpoint wants live values; pass False for the
     deterministic subset)."""
     lines: List[str] = []
+    typed: set = set()
     for inst in reg.instruments():
         if not (inst.stable or include_unstable):
             continue
-        name = _prom_name(inst.name)
-        lines.append(f"# TYPE {name} {inst.kind}")
+        base, labels = _split_labels(inst.name)
+        name = _mangle(base)
+        # ONE TYPE line per base name: labeled series of one base are
+        # samples of one metric, and a real Prometheus parser rejects a
+        # duplicate TYPE line (instruments iterate in sorted-name order,
+        # so same-base series are contiguous)
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} {inst.kind}")
         if isinstance(inst, Histogram):
+            pre = labels + "," if labels else ""
+            suf = f"{{{labels}}}" if labels else ""
             cum = 0
             for edge, c in zip(inst.buckets, inst.counts[:-1]):
                 cum += c
-                lines.append(f'{name}_bucket{{le="{_prom_num(edge)}"}} '
-                             f"{cum}")
+                lines.append(f'{name}_bucket{{{pre}le='
+                             f'"{_prom_num(edge)}"}} {cum}')
             cum += inst.counts[-1]
-            lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
-            lines.append(f"{name}_sum {_prom_num(inst.total)}")
-            lines.append(f"{name}_count {inst.count}")
+            lines.append(f'{name}_bucket{{{pre}le="+Inf"}} {cum}')
+            lines.append(f"{name}_sum{suf} {_prom_num(inst.total)}")
+            lines.append(f"{name}_count{suf} {inst.count}")
         else:
-            lines.append(f"{name} {_prom_num(inst.value)}")
+            suf = f"{{{labels}}}" if labels else ""
+            lines.append(f"{name}{suf} {_prom_num(inst.value)}")
     return "\n".join(lines) + "\n"
 
 

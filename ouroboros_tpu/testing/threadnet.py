@@ -404,6 +404,8 @@ from ..network.error_policy import (          # noqa: E402  (section import)
 )
 from ..node.diffusion import SimNetwork, run_sim_diffusion  # noqa: E402
 from ..node.watchdog import NodeTimeLimits    # noqa: E402
+from ..observe import netmetrics as _netmetrics             # noqa: E402
+from ..observe.propagation import FleetTelemetry            # noqa: E402
 from ..simharness import FaultPlan, FaultSpec, Partition    # noqa: E402
 
 
@@ -462,6 +464,10 @@ class ChaosResult(ThreadNetResult):
     fault_events: list = field(default_factory=list)   # plan.events
     workers: list = field(default_factory=list)        # SubscriptionWorkers
     race_report: Optional[object] = None   # RaceReport under explore=K
+    # the merged FleetTelemetry report (ISSUE 14): adoption quantiles,
+    # per-edge delivery latency, partition healing, per-peer mux bytes —
+    # byte-identical (sort_keys JSON) across replays of one seed
+    fleet: Optional[dict] = None
 
     # -- trace views ---------------------------------------------------------
     def _events(self, label: str) -> list:
@@ -578,6 +584,11 @@ def _chaos_setup(cfg: ChaosConfig):
         raise ValueError(net.topology)
 
     async def main():
+        # fresh fleet-accounting scope: MuxIO totals born in THIS run are
+        # what the fleet report folds, so two replays of one seed report
+        # identical per-peer bytes
+        _netmetrics.reset_run_scope()
+        fleet = FleetTelemetry(partitions=cfg.partitions)
         network = SimNetwork(
             link_delay=net.link_delay * net.slot_length,
             fault_plan=plan)
@@ -585,6 +596,7 @@ def _chaos_setup(cfg: ChaosConfig):
         # every address must be listening before any worker dials, or the
         # startup order would masquerade as connection failures
         for i, kern in enumerate(kernels):
+            kern.propagation = fleet.tracker(kern.label)
             network.listen(f"addr{i}", kern)
         worker_threads = []
         for i, kern in enumerate(kernels):
@@ -611,6 +623,7 @@ def _chaos_setup(cfg: ChaosConfig):
                 pass
             except BaseException as e:   # a THROW verdict or worker bug
                 result.failures.append(("subscription", t.label, e))
+        result.fleet = fleet.report()
         for kern in kernels:
             kern.stop()
 

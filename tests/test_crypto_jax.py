@@ -90,6 +90,10 @@ def test_point_add_double_match_python():
         assert got_dbl == want_dbl
 
 
+# slow: ~27s tracing this test's own ed25519 batch shape; valid +
+# tampered ed25519 verdicts vs the reference are tier-1-gated by bench
+# --smoke's verdict-parity mixed batch (which includes a bad-sig req)
+@pytest.mark.slow
 def test_batch_verify_valid_and_tampered():
     n = 12
     vks, msgs, sigs = [], [], []
@@ -111,6 +115,10 @@ def test_batch_verify_valid_and_tampered():
                     True, False, True, False]
 
 
+# slow: ~26s tracing a second ed25519 bucket shape just for the padding
+# probe; bench --smoke's replay + verdict-parity already run padded
+# buckets (10 reqs in a 16-lane bucket) with verdict parity in tier-1
+@pytest.mark.slow
 def test_batch_verify_padding_hits_same_result():
     sk = hashlib.sha256(b"pad").digest()
     vk = ed25519_ref.public_key(sk)
@@ -118,6 +126,10 @@ def test_batch_verify_padding_hits_same_result():
     assert EJ.batch_verify([vk], [b"m"], [sig], pad_to=8) == [True]
 
 
+# slow: ~55s tracing this test's own composite shape; the VRF+KES
+# verify_mixed path (valid + corrupted, vs CpuRefBackend) is
+# tier-1-gated at a shared shape by bench --smoke's verdict-parity
+@pytest.mark.slow
 def test_jax_backend_vrf_and_kes():
     from ouroboros_tpu.crypto.jax_backend import JaxBackend
     from ouroboros_tpu.crypto import CpuRefBackend, Ed25519Req, KesReq, VrfReq
@@ -171,6 +183,10 @@ def test_vrf_batch_autotunes_under_its_own_key(monkeypatch):
     assert keys == [("vrff", 16)]
 
 
+# slow: ~35s tracing this test's own vrf batch shape; beta correctness
+# is tier-1-gated through bench --smoke's state-hash parity (betas feed
+# the nonce evolution) and the fold-verdict parity probe
+@pytest.mark.slow
 def test_vrf_jax_batch_parity_and_betas():
     """batch_verify_vrf + batch_betas vs the pure-Python oracle, incl.
     tampered gamma/c/s, wrong vk, wrong alpha, garbage proofs."""

@@ -161,6 +161,10 @@ def test_y_canonical_mask():
 # ---------------------------------------------------------------------------
 
 @pytest.mark.device
+# slow: ~26s tracing the split-words program at this test's own shape;
+# bit-exactness of the packed-words cores stays covered nightly, and
+# the end-to-end verdict path is tier-1-gated by bench --smoke parity
+@pytest.mark.slow
 def test_split_words_verify_bit_exact_vs_reference():
     n = 128
     keys = [hashlib.sha256(b"k%d" % (i % 5)).digest() for i in range(n)]
@@ -206,11 +210,17 @@ def test_a128_cache_entries_match_scalar_mult():
 
 
 @pytest.mark.device
+@pytest.mark.slow
 def test_jax_backend_mixed_window_with_kes_device_hashes():
     """JaxBackend (XLA path off-chip) verify_mixed over Ed25519 + VRF +
     KES requests matches the pure-host oracle, including KES signatures
     with tampered hash paths (caught by the device Blake2b batch, not
-    host hashing)."""
+    host hashing).
+
+    slow: ~75s of per-process composite tracing for this test's own
+    window shape (no persistent cache avoids tracing — the PR 8
+    discipline); tier-1 gates the same mixed cold-KES window with
+    tampered hash paths via bench --smoke's verdict-parity probe."""
     from ouroboros_tpu.crypto import vrf_ref
     from ouroboros_tpu.crypto.backend import (
         CpuRefBackend, Ed25519Req, KesReq, VrfReq,

@@ -144,6 +144,10 @@ def test_triple_ladder_matches_xla_form_and_reference():
 #    pallas interpreter stepping the ladders.
 # ---------------------------------------------------------------------------
 
+# slow: ~26s tracing the interpret-mode ed25519 kernel; gamma8 below
+# stays as the tier-1 pallas-interpret representative, and the ed25519
+# verdict path is tier-1-gated by bench --smoke parity
+@pytest.mark.slow
 def test_ed25519_pallas_interpret_bit_exact():
     sk = hashlib.sha256(b"pallas-test").digest()
     vk = ed25519_ref.public_key(sk)
@@ -157,6 +161,10 @@ def test_ed25519_pallas_interpret_bit_exact():
     assert ok == [i not in bad for i in range(n)]
 
 
+# slow: ~57s tracing the interpret-mode VRF kernel; the ed25519 and
+# gamma8 interpret tests below keep pallas bit-exactness in tier-1,
+# and the VRF verdict path is tier-1-gated by bench --smoke parity
+@pytest.mark.slow
 def test_vrf_pallas_interpret_bit_exact():
     from ouroboros_tpu.crypto import vrf_jax
     sk = hashlib.sha256(b"pallas-vrf").digest()

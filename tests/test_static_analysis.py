@@ -620,9 +620,56 @@ def test_obs002_prebound_handle_clears_it():
         "    return reg.histogram('x').quantiles()\n", "fx.py") == []
 
 
+def test_obs003_dynamic_name_fires():
+    """A metric name built from a runtime value at the factory call is
+    the registry-cardinality bomb OBS003 exists for (ISSUE 14); the old
+    watchdog per-protocol counter shape fires both OBS003 (dynamic
+    name) and OBS002 (write chained onto the fresh lookup)."""
+    f = obs_lint(
+        "def fire(p):\n"
+        "    _metrics.counter(f'watchdog.firings.{p}').inc()\n", "fx.py")
+    assert _rules(f) == {"OBS002", "OBS003"}
+    # %-format, .format and str() name builds fire too
+    f = obs_lint(
+        "def series(reg, peer, num):\n"
+        "    h = reg.histogram('lat.%s' % peer)\n"
+        "    c = reg.counter('bytes.{}'.format(peer))\n"
+        "    g = reg.gauge(str(num))\n", "fx.py")
+    assert _rules(f) == {"OBS003"} and len(f) == 3
+
+
+def test_obs003_helper_and_static_names_clear():
+    """The sanctioned forms: the bounded-label helper (whose factory
+    leaf is not a registry factory) and static literal names."""
+    assert obs_lint(
+        "def fire(p):\n"
+        "    _net.labeled_counter('watchdog.firings_by_protocol',\n"
+        "                         protocol=p).inc()\n", "fx.py") == []
+    assert obs_lint(
+        "_C = _metrics.counter('watchdog.firings')\n"
+        "def fire():\n"
+        "    _C.inc()\n", "fx.py") == []
+    # a plain variable as the name is not flagged (the rule targets
+    # construction at the call site)
+    assert obs_lint(
+        "def bind(reg, name):\n"
+        "    return reg.counter(name)\n", "fx.py") == []
+
+
+def test_obs003_exempts_the_helper_itself():
+    """observe/netmetrics.py builds labeled names BY DESIGN: the
+    package scan must not flag the helper's own implementation."""
+    from tools.analysis.obs_pass import run_files
+    import os
+    path = os.path.join(REPO, "ouroboros_tpu", "observe",
+                        "netmetrics.py")
+    assert [f for f in run_files([path])
+            if f.rule == "OBS003"] == []
+
+
 def test_obs_pass_live_tree_clean_modulo_baseline():
-    """Acceptance (ISSUE 7 + 9): the only tolerated unguarded
-    construction / unbound instrument-write sites carry
+    """Acceptance (ISSUE 7 + 9 + 14): the only tolerated unguarded
+    construction / unbound instrument-write / dynamic-name sites carry
     justifications."""
     report = run_passes(["obs"], Baseline.load())
     assert report.new == [], "\n".join(f.render() for f in report.new)
@@ -631,9 +678,15 @@ def test_obs_pass_live_tree_clean_modulo_baseline():
     for e in entries:
         assert e["justification"].strip() and "TODO" not in \
             e["justification"], e
-    # the OBS002 satellite's justified-baseline contract is exercised by
-    # a real entry (the dynamic-name watchdog counter)
-    assert any(e["rule"] == "OBS002" for e in entries)
+    # the OBS003 satellite's justified-baseline contract is exercised by
+    # real entries (the bounded-by-construction span-category and
+    # event-class vocabularies); the old watchdog OBS002 entry is
+    # retired — its dynamic name now routes through the bounded-label
+    # helper
+    assert any(e["rule"] == "OBS003" for e in entries)
+    assert not any(e["rule"] == "OBS002"
+                   and e["file"] == "ouroboros_tpu/node/watchdog.py"
+                   for e in entries)
 
 
 # --- baseline canonical form -------------------------------------------------

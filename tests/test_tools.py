@@ -359,6 +359,76 @@ def test_perfgate_bench_and_multichip_combined(tmp_path):
     assert all(c["result"] != "FAIL" for c in doc["checks"])
 
 
+def _serve_round(tmp_path, n, serve=None):
+    doc = {"metric": "shelley_replay_proofs_per_sec", "value": 5000.0,
+           "unit": "proofs/s", "vs_baseline": 13.0}
+    if serve is not None:
+        doc["serve"] = serve
+    p = tmp_path / f"BENCH_r{n:02d}.json"
+    p.write_text(json.dumps({"n": n, "rc": 0, "parsed": doc}))
+    return str(p)
+
+
+_GREEN_SERVE = {"seed": 7, "deadline_secs": 0.05,
+                "saturated": {"vs_unbatched_cpu": 6.3,
+                              "p95_within_deadline": True}}
+
+
+def test_perfgate_serve_skips_on_preservice_history():
+    """ISSUE 14 satellite: the committed r01-r05 rounds predate the
+    serve section — every serve check reports skipped and the gate
+    passes (same binding pattern as --multichip)."""
+    import glob
+    rounds = sorted(glob.glob(os.path.join(REPO, "BENCH_r0*.json")))
+    r = _run("-m", "tools.perfgate", "--serve", *rounds)
+    assert r.returncode == 0, r.stdout + r.stderr
+    sv = json.loads(r.stdout)["serve"]
+    assert sv["ok"] is True and sv["binding"] is False
+    assert {c["result"] for c in sv["checks"]} == {"skipped"}
+
+
+def test_perfgate_serve_binds_and_gates(tmp_path):
+    """A round carrying a serve section makes the gate binding: the
+    5x-vs-unbatched floor and the p95-inside-deadline bar both
+    enforce."""
+    good = [_serve_round(tmp_path, 5),
+            _serve_round(tmp_path, 6, serve=_GREEN_SERVE)]
+    r = _run("-m", "tools.perfgate", "--serve", *good)
+    assert r.returncode == 0, r.stdout + r.stderr
+    sv = json.loads(r.stdout)["serve"]
+    assert sv["binding"] is True
+    assert {c["check"]: c["result"] for c in sv["checks"]} == {
+        "serve_vs_unbatched": "pass", "serve_p95_deadline": "pass"}
+
+    slow = dict(_GREEN_SERVE,
+                saturated={"vs_unbatched_cpu": 3.0,
+                           "p95_within_deadline": True})
+    d2 = tmp_path / "slow"
+    d2.mkdir()
+    bad = [_serve_round(d2, 6, serve=_GREEN_SERVE),
+           _serve_round(d2, 7, serve=slow)]
+    r = _run("-m", "tools.perfgate", "--serve", *bad)
+    assert r.returncode == 1, r.stdout + r.stderr
+    results = {c["check"]: c["result"]
+               for c in json.loads(r.stdout)["serve"]["checks"]}
+    assert results == {"serve_vs_unbatched": "FAIL",
+                       "serve_p95_deadline": "pass"}
+
+    missed = dict(_GREEN_SERVE,
+                  saturated={"vs_unbatched_cpu": 6.0,
+                             "p95_within_deadline": False})
+    d3 = tmp_path / "missed"
+    d3.mkdir()
+    bad = [_serve_round(d3, 6, serve=_GREEN_SERVE),
+           _serve_round(d3, 7, serve=missed)]
+    r = _run("-m", "tools.perfgate", "--serve", *bad)
+    assert r.returncode == 1
+    results = {c["check"]: c["result"]
+               for c in json.loads(r.stdout)["serve"]["checks"]}
+    assert results == {"serve_vs_unbatched": "pass",
+                       "serve_p95_deadline": "FAIL"}
+
+
 def test_obsreport_renders_mesh_section(tmp_path):
     """A MULTICHIP round with the full ISSUE-11 obs renders devices,
     compile attribution, sharded replay parity/throughput, per-shard
@@ -487,6 +557,80 @@ def test_obsreport_live_flag_wired():
     # PATH and --live are mutually exclusive
     r3 = _run("-m", "tools.obsreport")
     assert r3.returncode == 2
+
+
+def test_obsreport_fleet_renderer(tmp_path):
+    """--fleet renders a FleetTelemetry report (bare dict or one nested
+    under a dumped ChaosResult's `fleet` key); junk is rc 2."""
+    fleet = {
+        "nodes": ["node0", "node1"],
+        "adoption": {"blocks": 3, "fully_adopted_blocks": 2,
+                     "time_to_50": {"n": 3, "p50": 0.1, "p95": 0.2,
+                                    "max": 0.2},
+                     "time_to_95": {"n": 2, "p50": 0.3, "p95": 0.5,
+                                    "max": 0.5},
+                     "per_block": []},
+        "per_edge_delivery": {"node0->node1": {"n": 4, "p50": 0.05,
+                                               "p95": 0.07,
+                                               "max": 0.07}},
+        "partitions": [{"start": 3.0, "end": 5.0,
+                        "healed_after_secs": 0.42},
+                       {"start": 9.0, "end": 11.0,
+                        "healed_after_secs": None}],
+        "mux": {"node0->node1|i": {"ingress_bytes": 100,
+                                   "egress_bytes": 200,
+                                   "ingress_sdus": 2, "egress_sdus": 3,
+                                   "by_proto": {}}},
+    }
+    bare = tmp_path / "fleet.json"
+    bare.write_text(json.dumps(fleet))
+    wrapped = tmp_path / "chaos.json"
+    wrapped.write_text(json.dumps({"seed": 7, "fleet": fleet}))
+    for p in (bare, wrapped):
+        r = _run("-m", "tools.obsreport", "--fleet", str(p))
+        assert r.returncode == 0, r.stderr
+        assert "2 nodes, 3 blocks tracked" in r.stdout
+        assert "time to 95% of nodes" in r.stdout
+        assert "node0->node1" in r.stdout
+        assert "0.4200" in r.stdout and "NEVER" in r.stdout
+        assert "node0->node1|i" in r.stdout
+    bad = tmp_path / "junk.json"
+    bad.write_text('{"not": "a fleet report"}')
+    r = _run("-m", "tools.obsreport", "--fleet", str(bad))
+    assert r.returncode == 2 and "cannot read" in r.stderr
+
+
+def test_obsreport_flight_renderer(tmp_path):
+    """--flight renders a flight-recorder dump dir: reason header,
+    aggregated metric deltas, span/event tail.  A dir without a dump is
+    rc 2."""
+    from ouroboros_tpu.observe import flight as fl
+    from ouroboros_tpu.observe import metrics as om
+    from ouroboros_tpu.observe import spans as sp
+    reg = om.MetricsRegistry()
+    rec = sp.SpanRecorder()
+    f = fl.FlightRecorder(registry=reg, recorder=rec)
+    f.arm()
+    try:
+        c = reg.counter("probe.count")
+        c.inc(3)
+        c.inc(2)
+        reg.gauge("probe.gauge").set(7)
+        with rec.span("w", cat="device"):
+            pass
+        f.note(("tail", "event"))
+        d = tmp_path / "dump"
+        f.dump(str(d), reason="unit probe")
+    finally:
+        f.disarm()
+    r = _run("-m", "tools.obsreport", "--flight", str(d))
+    assert r.returncode == 0, r.stderr
+    assert "reason: unit probe" in r.stdout
+    assert "probe.count" in r.stdout and "+5" in r.stdout
+    assert "last=7" in r.stdout
+    assert "[device] w" in r.stdout
+    r2 = _run("-m", "tools.obsreport", "--flight", str(tmp_path / "no"))
+    assert r2.returncode == 2 and "cannot read flight dump" in r2.stderr
 
 
 def test_obsreport_cli(tmp_path):

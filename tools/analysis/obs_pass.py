@@ -35,6 +35,23 @@ payload does work must therefore sit under a `tracer.active` guard:
   hot loop); genuinely cold sites — a once-per-scrape handler — are
   tolerated via justified baseline entries.
 
+- OBS003 dynamic-instrument-name (ISSUE 14): a metric name BUILT from
+  runtime values — an f-string, `%`/`+` string concat, `.format(...)`
+  or `str(...)` as the name argument of a registry factory
+  (counter/gauge/histogram/latency_histogram).  A series per raw
+  runtime value (peer addr, protocol number) is an unbounded-
+  cardinality bomb on an O(100)-node net; route the dynamic part
+  through the bounded-label helper instead:
+
+    _net.labeled_counter("watchdog.firings_by_protocol",
+                         protocol=proto)                   # ok
+    _metrics.counter(f"watchdog.firings.{proto}")          # OBS003
+
+  OBS003 scans the whole package; observe/netmetrics.py itself (the
+  helper's implementation) is exempt.  Names bounded by construction
+  (a small author-declared vocabulary, memoised per handle) are
+  tolerated via justified baseline entries.
+
 Cheap payloads (names, constants, attribute reads, plain tuples of
 those) pass OBS001: a tuple build of locals is two bytecode ops, the
 guard would cost as much as it saves.  Cold-path sites (an autotune
@@ -50,9 +67,13 @@ from . import Finding, register, relpath
 from .astutil import QualnameVisitor, dotted_name, iter_py_files, parse_file
 
 SCAN_DIRS = ("ouroboros_tpu/crypto", "ouroboros_tpu/parallel")
-# OBS002 applies package-wide: pre-binding costs nothing, and hot loops
-# appear outside crypto/ (pipeline drains, mempool admission, mux)
+# OBS002/OBS003 apply package-wide: pre-binding and bounded labels cost
+# nothing, and hot loops appear outside crypto/ (pipeline drains,
+# mempool admission, mux)
 OBS2_SCAN_DIRS = ("ouroboros_tpu",)
+# the bounded-label helper builds labeled names BY DESIGN — exempt from
+# its own rule
+OBS3_EXEMPT_FILES = ("ouroboros_tpu/observe/netmetrics.py",)
 
 _TRACE_FN_NAMES = {"trace_event", "sim.trace_event"}
 
@@ -62,6 +83,9 @@ _INSTRUMENT_WRITES = {"histogram": "observe",
                       "latency_histogram": "observe",
                       "counter": "inc",
                       "gauge": "set"}
+
+# factory leafs whose NAME argument OBS003 inspects
+_INSTRUMENT_FACTORIES = frozenset(_INSTRUMENT_WRITES)
 
 
 def _is_trace_call(node: ast.Call) -> bool:
@@ -90,6 +114,42 @@ def _expensive(node: ast.AST) -> bool:
 def _guard_mentions_active(test: ast.AST) -> bool:
     return any(isinstance(sub, ast.Attribute) and sub.attr == "active"
                for sub in ast.walk(test))
+
+
+def _dynamic_name_arg(node: ast.Call) -> bool:
+    """Is this call's metric-name argument BUILT from runtime values —
+    an f-string, a non-constant `%`/`+` concat, `.format(...)` or
+    `str(...)`?  Plain names/attributes are not flagged (the rule
+    targets construction at the call site, where the helper belongs)."""
+    arg = None
+    if node.args:
+        arg = node.args[0]
+    else:
+        for kw in node.keywords:
+            if kw.arg == "name":
+                arg = kw.value
+                break
+    if arg is None:
+        return False
+    if isinstance(arg, ast.JoinedStr):
+        return True
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op,
+                                                 (ast.Mod, ast.Add)):
+        return not (isinstance(arg.left, ast.Constant)
+                    and isinstance(arg.right, ast.Constant))
+    if isinstance(arg, ast.Call):
+        if isinstance(arg.func, ast.Attribute) \
+                and arg.func.attr == "format":
+            return True
+        return dotted_name(arg.func) == "str"
+    return False
+
+
+def _instrument_factory_call(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    if name is None:
+        return False
+    return name.rsplit(".", 1)[-1] in _INSTRUMENT_FACTORIES
 
 
 def _unbound_instrument_write(node: ast.Call) -> bool:
@@ -153,11 +213,21 @@ class _ObsLint(QualnameVisitor):
                         "lookup; pre-bind the handle once "
                         "(H = metrics.histogram(...)) at module/init "
                         "scope and call H.observe(v) on the hot path"))
+        if "OBS003" in self.rules and _instrument_factory_call(node) \
+                and _dynamic_name_arg(node):
+            self.findings.append(Finding(
+                file=self.file, line=node.lineno, rule="OBS003",
+                symbol=self.qualname,
+                message="metric name built from runtime values "
+                        "(unbounded registry cardinality); route the "
+                        "dynamic part through the bounded-label helper "
+                        "(observe/netmetrics.py labeled_counter/"
+                        "labeled_gauge/peer_label)"))
         self.generic_visit(node)
 
 
 def lint_source(source: str, file: str,
-                rules: Iterable[str] = ("OBS001", "OBS002")
+                rules: Iterable[str] = ("OBS001", "OBS002", "OBS003")
                 ) -> List[Finding]:
     """Run the OBS pass over one source text (fixture entry point)."""
     findings: List[Finding] = []
@@ -167,23 +237,27 @@ def lint_source(source: str, file: str,
 
 
 def run_files(paths: Iterable[str],
-              rules: Iterable[str] = ("OBS001", "OBS002")
+              rules: Iterable[str] = ("OBS001", "OBS002", "OBS003")
               ) -> List[Finding]:
     findings: List[Finding] = []
     for path in paths:
-        lint = _ObsLint(relpath(path), findings, rules)
+        rel = relpath(path)
+        file_rules = rules if rel not in OBS3_EXEMPT_FILES else \
+            tuple(r for r in rules if r != "OBS003")
+        lint = _ObsLint(rel, findings, file_rules)
         lint.visit(parse_file(path))
     return sorted(set(findings))
 
 
 @register("obs")
 def run() -> List[Finding]:
-    # OBS001+OBS002 on the crypto/parallel hot paths; OBS002 alone over
-    # the rest of the package (OBS001's tracer-payload rule would drown
-    # in the cold protocol layers, where a guard costs more than it
-    # saves — the unbound-handle rule is cheap to satisfy anywhere)
+    # OBS001+OBS002+OBS003 on the crypto/parallel hot paths; OBS002+
+    # OBS003 over the rest of the package (OBS001's tracer-payload rule
+    # would drown in the cold protocol layers, where a guard costs more
+    # than it saves — the unbound-handle and bounded-label rules are
+    # cheap to satisfy anywhere)
     hot = set(iter_py_files(*SCAN_DIRS))
     findings = run_files(sorted(hot))
     rest = [p for p in iter_py_files(*OBS2_SCAN_DIRS) if p not in hot]
-    findings += run_files(sorted(rest), rules=("OBS002",))
+    findings += run_files(sorted(rest), rules=("OBS002", "OBS003"))
     return sorted(set(findings))

@@ -5,6 +5,8 @@ sections, and a live view of a running node's scrape endpoint.
     python -m tools.obsreport MULTICHIP_r06.json
     python bench.py > out.json && python -m tools.obsreport out.json
     python -m tools.obsreport --live 127.0.0.1:9187 [--interval 5]
+    python -m tools.obsreport --fleet fleet.json
+    python -m tools.obsreport --flight /tmp/ouro-flight [--tail 20]
 
 Accepts a raw bench JSON object (what `python bench.py` prints), a
 harness record wrapping one under ``parsed`` (the committed
@@ -37,6 +39,16 @@ than failing, so the CLI works across the whole BENCH_r*.json history.
 transport) and renders replay progress (blocks done / ETA / blocks per
 sec / windows in flight / hidden fraction) plus p50/p95/p99 for every
 latency histogram — repeat with ``--interval N``.
+
+``--fleet PATH`` renders a FleetTelemetry report (the JSON dict a chaos
+threadnet run leaves on ``ChaosResult.fleet``, ISSUE 14): time-to-50%/
+95%-adoption quantiles, per-edge delivery latency, partition-healing
+times, and the per-peer mux byte accounting.
+
+``--flight DIR`` renders a flight-recorder dump directory
+(observe/flight.py): the reason header, aggregated metric deltas, and
+the last ``--tail`` span/event ring entries — post-mortems no longer
+require hand-reading flight.jsonl.
 
 Exit codes: 0 report printed, 2 unreadable/unrecognised input.
 """
@@ -346,6 +358,167 @@ def render_multichip(doc: dict) -> str:
 
 
 # ---------------------------------------------------------------------------
+# --fleet: render a FleetTelemetry report (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+def load_fleet(path: str) -> dict:
+    """The fleet report dict from `path`; accepts the bare report or a
+    wrapper carrying it under ``fleet`` (a dumped ChaosResult)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "adoption" not in doc \
+            and isinstance(doc.get("fleet"), dict):
+        doc = doc["fleet"]
+    if not isinstance(doc, dict) or "adoption" not in doc \
+            or "nodes" not in doc:
+        raise ValueError("not a fleet report (no 'adoption'/'nodes')")
+    return doc
+
+
+def _fmt_dist(d: dict) -> List[str]:
+    return [str(d.get("n", 0)), _fmt_secs(d.get("p50")),
+            _fmt_secs(d.get("p95")), _fmt_secs(d.get("max"))]
+
+
+def render_fleet(doc: dict) -> str:
+    out: List[str] = []
+    nodes = doc.get("nodes") or []
+    ad = doc.get("adoption") or {}
+    out.append(f"fleet telemetry: {len(nodes)} nodes, "
+               f"{ad.get('blocks', 0)} blocks tracked "
+               f"({ad.get('fully_adopted_blocks', 0)} adopted by every "
+               f"node)")
+    out.append("")
+    out.append("block adoption (seconds from first adoption; "
+               "quantiles over blocks):")
+    rows = [["time to 50% of nodes"] + _fmt_dist(ad.get("time_to_50")
+                                                 or {}),
+            ["time to 95% of nodes"] + _fmt_dist(ad.get("time_to_95")
+                                                 or {})]
+    out += _table(rows, ["quantity", "blocks", "p50", "p95", "max"])
+
+    edges = doc.get("per_edge_delivery") or {}
+    out.append("")
+    if edges:
+        out.append("per-edge delivery latency (receiver first-header-"
+                   "seen minus sender adoption, seconds):")
+        rows = [[edge] + _fmt_dist(edges[edge]) for edge in sorted(edges)]
+        out += _table(rows, ["edge", "n", "p50", "p95", "max"])
+    else:
+        out.append("no per-edge deliveries recorded")
+
+    parts = doc.get("partitions") or []
+    if parts:
+        out.append("")
+        out.append("partition healing (first cross-group delivery "
+                   "after the window):")
+        rows = [[p.get("start"), p.get("end"),
+                 _fmt_secs(p.get("healed_after_secs"))
+                 if p.get("healed_after_secs") is not None
+                 else "NEVER"] for p in parts]
+        out += _table(rows, ["start", "end", "healed after (s)"])
+
+    mux = doc.get("mux") or {}
+    out.append("")
+    if mux:
+        out.append("per-peer mux accounting (edge|side; bytes are SDU "
+                   "payload bytes):")
+        rows = []
+        for key in sorted(mux):
+            m = mux[key]
+            rows.append([key, m.get("egress_bytes"),
+                         m.get("egress_sdus"), m.get("ingress_bytes"),
+                         m.get("ingress_sdus")])
+        out += _table(rows, ["connection", "out B", "out SDU",
+                             "in B", "in SDU"])
+    else:
+        out.append("no mux accounting in this report")
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# --flight: render a flight-recorder dump directory (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+def load_flight(dir_path: str) -> tuple:
+    """(header, records) from DIR/flight.jsonl (observe/flight.py dump
+    layout).  Raises on a missing/garbled dump."""
+    import os
+    path = os.path.join(dir_path, "flight.jsonl")
+    header: Optional[dict] = None
+    records: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if header is None and rec.get("kind") == "flight":
+                header = rec
+                continue
+            records.append(rec)
+    if header is None:
+        raise ValueError(f"{path}: no flight header line")
+    return header, records
+
+
+def render_flight(header: dict, records: List[dict],
+                  tail: int = 20) -> str:
+    out: List[str] = []
+    out.append(f"flight dump: {header.get('entries')} ring entries — "
+               f"reason: {header.get('reason') or '(none)'}")
+
+    # -- aggregated metric deltas -------------------------------------------
+    deltas: dict = {}
+    for r in records:
+        if r.get("kind") != "metric":
+            continue
+        name, op, v = r.get("name"), r.get("op"), r.get("v")
+        d = deltas.setdefault(name, {"inc": 0, "observe": 0,
+                                     "set": None})
+        if op == "inc":
+            d["inc"] += v
+        elif op == "observe":
+            d["observe"] += 1
+        elif op == "set":
+            d["set"] = v
+    out.append("")
+    if deltas:
+        out.append("metric deltas over the ring:")
+        rows = []
+        for name in sorted(deltas):
+            d = deltas[name]
+            what = []
+            if d["inc"]:
+                what.append(f"+{d['inc']}")
+            if d["observe"]:
+                what.append(f"{d['observe']} obs")
+            if d["set"] is not None:
+                what.append(f"last={d['set']}")
+            rows.append([name, " ".join(what) or "-"])
+        out += _table(rows, ["metric", "delta"])
+    else:
+        out.append("no metric entries in the ring")
+
+    # -- span/event tail -----------------------------------------------------
+    trail = [r for r in records if r.get("kind") in ("span", "event")]
+    out.append("")
+    out.append(f"last {min(tail, len(trail))} span/event entries "
+               f"(of {len(trail)}):")
+    for r in trail[-tail:]:
+        if r.get("kind") == "span":
+            out.append(f"  {r.get('t'):>14.6f}  span   "
+                       f"[{r.get('cat')}] {r.get('name')} "
+                       f"({(r.get('t1') - r.get('t0')):.6f}s)")
+        else:
+            detail = {k: v for k, v in r.items()
+                      if k not in ("t", "kind")}
+            out.append(f"  {r.get('t'):>14.6f}  event  "
+                       f"{json.dumps(detail, sort_keys=True)[:120]}")
+    return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
 # --live: render a scraped exposition (replay progress + latency quantiles)
 # ---------------------------------------------------------------------------
 
@@ -424,12 +597,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--interval", type=float, default=0.0,
                     help="with --live: re-scrape every N seconds until "
                          "interrupted (default: once)")
+    ap.add_argument("--fleet", metavar="PATH",
+                    help="render a FleetTelemetry report JSON (a chaos "
+                         "run's ChaosResult.fleet)")
+    ap.add_argument("--flight", metavar="DIR",
+                    help="render a flight-recorder dump directory")
+    ap.add_argument("--tail", type=int, default=20,
+                    help="with --flight: span/event tail length "
+                         "(default 20)")
     args = ap.parse_args(sys.argv[1:] if argv is None else argv)
-    if (args.path is None) == (args.live is None):
+    modes = [m for m in (args.path, args.live, args.fleet, args.flight)
+             if m is not None]
+    if len(modes) != 1:
         ap.print_usage(sys.stderr)
-        print("obsreport: give exactly one of PATH or --live ADDR",
-              file=sys.stderr)
+        print("obsreport: give exactly one of PATH, --live ADDR, "
+              "--fleet PATH or --flight DIR", file=sys.stderr)
         return 2
+    if args.fleet:
+        try:
+            doc = load_fleet(args.fleet)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"obsreport: cannot read {args.fleet}: {e}",
+                  file=sys.stderr)
+            return 2
+        sys.stdout.write(render_fleet(doc))
+        return 0
+    if args.flight:
+        try:
+            header, records = load_flight(args.flight)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"obsreport: cannot read flight dump {args.flight}: "
+                  f"{e}", file=sys.stderr)
+            return 2
+        sys.stdout.write(render_flight(header, records, tail=args.tail))
+        return 0
     if args.live:
         from ouroboros_tpu.observe.export import parse_prometheus_text
         try:

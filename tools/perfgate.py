@@ -46,6 +46,13 @@ none with that section), and the gate reports their checks as skipped
 instead of failing tier-1 retroactively.  From the first green sharded
 round onward, a later red round fails the gate.
 
+``--serve BENCH_r*.json`` gates the verification-service trajectory
+(the ``serve`` section bench emits since ISSUE 12, recorded from r06
+on) with the same binding pattern: once any recorded round carries a
+``serve`` section, the latest round's saturated leg must hold
+``vs_unbatched_cpu >= 5.0`` and ``p95_within_deadline`` — earlier
+rounds report their checks as skipped.
+
 Exit codes: 0 pass, 1 regression, 2 unreadable/unrecognised input.
 One JSON verdict object is printed on stdout either way.
 """
@@ -72,6 +79,10 @@ DEFAULT_MAX_SPREAD = 0.35
 LEGACY_MAX_SPREAD = 0.45
 SPREAD_BINDS_FROM_ROUND = 6
 DEFAULT_MIN_HIDDEN_FRAC = 0.25
+# the ISSUE 12 acceptance bar the serve section was landed against:
+# saturated coalescing must beat the unbatched per-request CPU baseline
+# by 5x with p95 inside the deadline
+SERVE_MIN_VS_UNBATCHED = 5.0
 
 
 def _round_no(path: str) -> Optional[int]:
@@ -161,6 +172,56 @@ def check_trajectory(paths: List[str],
             "thresholds": {"max_drop": max_drop,
                            "max_spread": max_spread,
                            "min_hidden_frac": min_hidden_frac},
+            "checks": checks}
+
+
+# ---------------------------------------------------------------------------
+# Verification-service gate (ISSUE 14 satellite over the ISSUE 12 section)
+# ---------------------------------------------------------------------------
+
+def check_serve(paths: List[str],
+                min_vs_unbatched: float = SERVE_MIN_VS_UNBATCHED) -> dict:
+    """Judge the newest round's ``serve`` section.  Binding only once
+    some recorded round carries one (bench emits it from r06 on); the
+    pre-service history reports skipped — the --multichip pattern."""
+    if not paths:
+        raise ValueError("no bench rounds given")
+    rounds = []
+    for p in paths:
+        doc = load_bench(p)
+        rounds.append({"path": os.path.basename(p),
+                       "round": _round_no(p),
+                       "serve": doc.get("serve")})
+    if all(r["round"] is not None for r in rounds):
+        rounds.sort(key=lambda r: r["round"])
+    latest = rounds[-1]
+    binding = any(r["serve"] for r in rounds)
+    sat = (latest["serve"] or {}).get("saturated") or {}
+    checks: List[dict] = []
+
+    def check(name: str, ok: bool, detail: str) -> None:
+        if not binding:
+            result = "skipped"
+            detail += " [advisory: no serve-section round recorded yet]"
+        else:
+            result = "pass" if ok else "FAIL"
+        checks.append({"check": name, "result": result, "detail": detail})
+
+    vs = sat.get("vs_unbatched_cpu")
+    check("serve_vs_unbatched",
+          vs is not None and vs >= min_vs_unbatched,
+          f"latest saturated vs_unbatched_cpu {vs} vs floor "
+          f"{min_vs_unbatched}")
+    check("serve_p95_deadline", sat.get("p95_within_deadline") is True,
+          f"latest saturated p95_within_deadline="
+          f"{sat.get('p95_within_deadline')} "
+          f"(deadline {(latest['serve'] or {}).get('deadline_secs')}s)")
+
+    return {"ok": all(c["result"] != "FAIL" for c in checks),
+            "latest": latest["path"],
+            "binding": binding,
+            "rounds": [{"path": r["path"],
+                        "has_serve": bool(r["serve"])} for r in rounds],
             "checks": checks}
 
 
@@ -265,9 +326,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "dryrun trajectory (rc=0, compile attribution, "
                          "sharded replay parity) alongside — or instead "
                          "of — the BENCH rounds")
+    ap.add_argument("--serve", nargs="+", default=[], metavar="PATH",
+                    help="BENCH_rNN.json round files: gate the "
+                         "verification-service serve section (saturated "
+                         f"vs_unbatched >= {SERVE_MIN_VS_UNBATCHED}x, "
+                         "p95 inside the deadline); rounds predating "
+                         "the section report skipped")
     args = ap.parse_args(argv)
     paths = list(args.paths) + list(args.check)
-    if not paths and not args.multichip:
+    if not paths and not args.multichip and not args.serve:
         print("perfgate: no rounds given", file=sys.stderr)
         return 2
     verdict: dict = {"ok": True}
@@ -281,6 +348,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             mc = check_multichip(args.multichip)
             verdict["multichip"] = mc
             verdict["ok"] = verdict["ok"] and mc["ok"]
+        if args.serve:
+            sv = check_serve(args.serve)
+            verdict["serve"] = sv
+            verdict["ok"] = verdict["ok"] and sv["ok"]
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"perfgate: cannot judge trajectory: {e}", file=sys.stderr)
         return 2
