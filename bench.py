@@ -37,6 +37,13 @@ per-shard padding waste) beside the single-device number under a
 XLA devices (the flag is set before jax initialises); on TPU the same
 knob shards over the real chips.
 
+Every round also runs a ``stream`` leg (ISSUE 15): the same chain
+replayed FROM DISK through the streaming engine
+(ouroboros_tpu/storage/stream.py) — bounded read-ahead prefetch +
+periodic crash-consistent snapshots + a resumed restart — reporting how
+many disk+decode seconds hid under device verify (`disk_hidden_frac`)
+and the restore cost of a restart.
+
 `--serve` (ISSUE 12) exercises the CAUGHT-UP path instead of the
 syncing one: the adaptive micro-batching VerifyService
 (crypto/batching.py) under seeded bursty Poisson arrival traces in
@@ -234,16 +241,35 @@ def synth_chain(tmp: str, extra: tuple = ()) -> str:
     return d
 
 
+_DBA = None
+
+
+def _dba():
+    """The db_analyser module, loaded once (it is a script, not a
+    package member)."""
+    global _DBA
+    if _DBA is None:
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "dba", os.path.join(REPO, "tools", "db_analyser.py"))
+        _DBA = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(_DBA)
+    return _DBA
+
+
 def load(db_dir):
-    sys.path.insert(0, os.path.join(REPO, "tools"))
-    import importlib.util
-    spec = importlib.util.spec_from_file_location(
-        "dba", os.path.join(REPO, "tools", "db_analyser.py"))
-    dba = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(dba)
-    db, rules, decode, cfg = dba.load_db(db_dir)
+    db, rules, decode, cfg = _dba().load_db(db_dir)
     blocks = [decode(raw) for _entry, raw in db.stream()]
     return rules, blocks
+
+
+def load_stream_ctx(db_dir):
+    """(fs, db, rules, decode) for the streaming engine — the on-disk
+    half of what `load` materialises in memory."""
+    from ouroboros_tpu.storage import IoFS
+    db, rules, decode, _cfg = _dba().load_db(db_dir)
+    return IoFS(db_dir), db, rules, decode
 
 
 def replay(rules, blocks, backend, window: int):
@@ -616,6 +642,7 @@ def smoke(blocks: int = 8, window: int = 8):
         perfgate_ok, _perfgate_verdict = _smoke_perfgate()
         sharded_probe = _smoke_sharded_replay(rules, blocks_l)
         serve_probe = _smoke_serve()
+        stream_probe = _smoke_stream(chain, jb, cpu_hash)
         result = {"metric": "bench_smoke", "value": 1.0,
                   "blocks": len(blocks_l), "proofs": n_proofs,
                   "state_hash_parity": bool(hash_ok),
@@ -638,6 +665,7 @@ def smoke(blocks: int = 8, window: int = 8):
                   "perfgate_ok": bool(perfgate_ok),
                   "sharded_replay_smoke": sharded_probe,
                   "serve_probe": serve_probe,
+                  "stream_probe": stream_probe,
                   "precompute": GLOBAL_PRECOMPUTE_CACHE.stats()}
         if not (hash_ok and verdict_ok and fold_ok
                 and producers_run >= 1 and leaked == 0
@@ -650,7 +678,7 @@ def smoke(blocks: int = 8, window: int = 8):
                 and scrape_ok and scrape_leaked == 0
                 and net_probe["ok"]
                 and perfgate_ok and sharded_probe["ok"]
-                and serve_probe["ok"]):
+                and serve_probe["ok"] and stream_probe["ok"]):
             result["value"] = 0.0
             print(json.dumps(result))
             raise SystemExit(f"bench --smoke parity failure: {result}")
@@ -987,6 +1015,58 @@ def _clear_beta_cache():
     GLOBAL_BETA_CACHE.clear()
 
 
+def _smoke_stream(chain_dir, jb, cpu_hash):
+    """Streaming-engine smoke (ISSUE 15): replay the smoke chain FROM
+    DISK through storage/stream.py — prefetch thread + pipelined verify
+    + DiskPolicy snapshot — then reopen with resume and restore the tip
+    checkpoint.  Composite-shape discipline (tier1-budget memory): the
+    KES outcome cache is re-colded first so the engine's window takes
+    the SAME cold ('win', ne, nv, nb, nk) shape the parity replay
+    already compiled — zero fresh XLA:CPU compiles; the window size (8)
+    matches for the same reason.
+
+    Gates: state-hash parity vs the CPU baseline, >=1 chunk streamed,
+    >=1 crash-consistent snapshot written, the resumed reopen replays
+    ZERO blocks to the SAME hash, and neither the prefetcher nor the
+    producer leaks a thread."""
+    from ouroboros_tpu.crypto.precompute import GLOBAL_PRECOMPUTE_CACHE
+    from ouroboros_tpu.storage import (
+        DiskPolicy, StreamConfig, StreamingReplayEngine,
+    )
+    from ouroboros_tpu.storage.stream import prefetcher_threads_alive
+
+    fs, db, rules, decode = load_stream_ctx(chain_dir)
+    cfg = StreamConfig(window=8, read_ahead=2,
+                       policy=DiskPolicy(num_snapshots=2,
+                                         snapshot_interval_slots=4),
+                       resume=False)
+    GLOBAL_PRECOMPUTE_CACHE._kes.clear()
+    _clear_beta_cache()
+    res = StreamingReplayEngine(fs, db, rules, decode, backend=jb,
+                                config=cfg).replay()
+    hash_ok = (res.all_valid
+               and res.final_state.ledger.state_hash() == cpu_hash)
+    # resume: restores the tip snapshot, streams nothing, same hash —
+    # the restart-in-seconds contract, on the real backend, for free
+    _clear_beta_cache()
+    res2 = StreamingReplayEngine(
+        fs, db, rules, decode, backend=jb,
+        config=StreamConfig(window=8, read_ahead=2, policy=cfg.policy,
+                            resume=True)).replay()
+    resume_ok = (res2.all_valid and res2.n_valid == 0
+                 and res2.stats["resumed_from_slot"] is not None
+                 and res2.final_state.ledger.state_hash() == cpu_hash)
+    leaked = prefetcher_threads_alive() + _smoke_producer_leak()
+    ok = (hash_ok and resume_ok and res.stats["chunks_read"] >= 1
+          and res.stats["snapshots_written"] >= 1 and leaked == 0)
+    return {"ok": bool(ok), "state_hash_parity": bool(hash_ok),
+            "resume_parity": bool(resume_ok),
+            "resumed_from_slot": res2.stats["resumed_from_slot"],
+            "restore_secs": res2.stats["restore_secs"],
+            "threads_leaked": int(leaked),
+            "stats": res.stats}
+
+
 # ---------------------------------------------------------------------------
 # --serve: the adaptive micro-batching verification service under seeded
 # bursty arrival traces, in deterministic sim time (ISSUE 12)
@@ -1283,6 +1363,51 @@ def _smoke_serve():
     return res
 
 
+def _stream_leg(chain_dir, jb, cpu_hash, n_proofs):
+    """The ``stream`` section of a bench round (ISSUE 15): ONE replay of
+    the same chain FROM DISK through the streaming engine — read-ahead
+    prefetch + pipelined verify + periodic snapshots — on the
+    already-warm backend (every window shape was pinned by the main
+    replays), then a resumed reopen restoring the tip checkpoint.  The
+    disk_hidden_frac it reports is the engine's whole point: the
+    fraction of disk+decode seconds that ran while a window was in
+    flight on device."""
+    from ouroboros_tpu.storage import (
+        DiskPolicy, StreamConfig, StreamingReplayEngine,
+    )
+    fs, db, rules, decode = load_stream_ctx(chain_dir)
+    cfg = StreamConfig(window=WINDOW, read_ahead=4,
+                       policy=DiskPolicy(num_snapshots=2,
+                                         snapshot_interval_slots=max(
+                                             1, EPOCH_LEN)),
+                       resume=False)
+    _clear_beta_cache()
+    res = StreamingReplayEngine(fs, db, rules, decode, backend=jb,
+                                config=cfg).replay()
+    if not res.all_valid:
+        raise SystemExit(f"stream leg failed at block {res.n_valid}: "
+                         f"{res.error}")
+    parity = res.final_state.ledger.state_hash() == cpu_hash
+    _clear_beta_cache()
+    res2 = StreamingReplayEngine(
+        fs, db, rules, decode, backend=jb,
+        config=StreamConfig(window=WINDOW, read_ahead=4,
+                            policy=cfg.policy, resume=True)).replay()
+    out = dict(res.stats)
+    out["state_hash_parity"] = bool(parity)
+    out["proofs_per_sec"] = round(n_proofs / res.stats["replay_secs"], 1)
+    out["restart"] = {
+        "restore_secs": res2.stats["restore_secs"],
+        "blocks_replayed": res2.n_valid,
+        "state_hash_parity": bool(
+            res2.all_valid and res2.final_state is not None
+            and res2.final_state.ledger.state_hash() == cpu_hash),
+    }
+    if not parity:
+        raise SystemExit("stream leg state hash parity violated")
+    return out
+
+
 def _mesh_leg(rules, blocks, cpu_hash, cpu_secs, tpu_secs, n_proofs,
               mesh_n: int):
     """The sharded pipelined replay leg of the bench (ISSUE 11): the
@@ -1466,6 +1591,14 @@ def main(mesh_n: int = None):
         if vrf_attr:
             log(f"vrf primitive below best recorded round: {vrf_attr}")
 
+        # streaming-engine leg: the same chain replayed FROM DISK with
+        # read-ahead + snapshots + a resumed restart (warm shapes only)
+        stream = _stream_leg(chain, jb, cpu_hash, n_proofs)
+        log(f"stream: {stream['disk_secs']}s disk+decode, "
+            f"{100 * stream['disk_hidden_frac']:.0f}% hidden under "
+            f"device; {stream['snapshots_written']} snapshots, restart "
+            f"restored in {stream['restart']['restore_secs']}s")
+
         sharded = None
         if mesh_n:
             sharded = _mesh_leg(rules, blocks, cpu_hash, cpu_secs,
@@ -1512,6 +1645,7 @@ def main(mesh_n: int = None):
             "precompute": GLOBAL_PRECOMPUTE_CACHE.stats(),
             "primitives": prim,
             "primitives_vs_previous": prim_vs_prev,
+            "stream": stream,
             **({"vrf_attribution": vrf_attr} if vrf_attr else {}),
             **({"sharded": sharded} if sharded else {}),
         }))

@@ -232,7 +232,10 @@ def replay_blocks_pipelined(
         blocks,
         ext_state: ExtLedgerState,
         backend: Optional[CryptoBackend] = None,
-        window: int = 512) -> ReplayResult:
+        window: int = 512,
+        total_blocks=None,
+        tracker=None,
+        on_window=None) -> ReplayResult:
     """Producer/consumer-pipelined replay: a background producer thread
     runs window w+1's sequential pass, request packing and async submit
     WHILE the caller thread blocks on window w's device results — host
@@ -271,9 +274,16 @@ def replay_blocks_pipelined(
     `bench.py --mesh N` and the multichip dryrun are the measured
     entry points.
 
+    `on_window(state, n_done, point)` fires after each window is FULLY
+    verified — the streaming engine's snapshot seam (identical contract
+    on the threaded and the synchronous fallback drivers); `tracker`
+    shares one pipeline ProgressTracker across stages.
+
     Falls back to the synchronous windowed driver on backends without
     submit_window."""
     import itertools
+
+    from ..chain.block import Point
 
     backend = backend or default_backend()
     submit = getattr(backend, "submit_window", None)
@@ -286,9 +296,34 @@ def replay_blocks_pipelined(
             w = list(itertools.islice(block_iter, window))
             if not w:
                 break
-            res = validate_blocks_batched(ext_rules, w, st,
-                                          backend=backend)
+            # the synchronous validate IS this driver's in-flight
+            # window: bracketing it keeps the shared tracker honest —
+            # the streaming engine's prefetch thread genuinely overlaps
+            # it (disk_hidden accrues), and the live progress gauges
+            # advance per window instead of freezing for the whole run
+            if tracker is not None:
+                tracker.window_submitted()
+            n_ok = 0
+            try:
+                res = validate_blocks_batched(ext_rules, w, st,
+                                              backend=backend)
+                n_ok = res.n_valid
+            finally:
+                if tracker is not None:
+                    tracker.window_drained(n_ok)
             done += res.n_valid
+            # hook parity with the threaded driver: a window that died
+            # on a PROOF failure yields no checkpoint (the threaded
+            # drain cannot attribute a partial prefix), while a
+            # retry-later horizon wait still checkpoints its verified
+            # prefix on both drivers
+            if on_window is not None and res.n_valid \
+                    and (res.all_valid
+                         or isinstance(res.error, OutsideForecastRange)):
+                last = getattr(w[res.n_valid - 1], "header",
+                               w[res.n_valid - 1])
+                on_window(res.states[-1], done, Point(last.slot,
+                                                      last.hash))
             if not res.all_valid:
                 resume = (res.final_state or st
                           if isinstance(res.error, OutsideForecastRange)
@@ -299,4 +334,6 @@ def replay_blocks_pipelined(
 
     from .pipeline import replay_threaded
     return replay_threaded(ext_rules, blocks, ext_state, backend,
-                           window=window)  # total inferred from len()
+                           window=window, total_blocks=total_blocks,
+                           tracker=tracker,
+                           on_window=on_window)  # total from len() too

@@ -65,6 +65,7 @@ import threading
 from collections import deque
 from typing import Any, Optional
 
+from ..chain.block import Point
 from ..crypto.backend import GLOBAL_BETA_CACHE, WindowVerdict
 from ..observe import flight as _flight
 from ..observe import metrics as _metrics
@@ -108,6 +109,11 @@ _P_HIDDEN = _metrics.gauge("replay.progress.hidden_frac", stable=False)
 # live scrape of a sharded replay names its mesh
 _P_DEVICES = _metrics.gauge("replay.progress.devices")
 _P_PAD_WASTE = _metrics.gauge("replay.progress.padding_waste_frac")
+# streaming-replay disk overlap (ISSUE 15): disk+decode seconds the
+# prefetch thread spent while >= 1 window was in flight on device —
+# published live so a scrape of a streaming replay shows whether the
+# read-ahead is actually hiding the storage layer
+_S_HIDDEN = _metrics.gauge("replay.stream.hidden_frac", stable=False)
 
 
 class ProgressTracker:
@@ -119,12 +125,18 @@ class ProgressTracker:
     on/off signals with O(1) transitions (host edges from the producer,
     in-flight edges from submit/drain), so the intersection accumulates
     in a scalar — no interval lists to keep, which matters at
-    million-block scale.  ETA uses the blocks/sec observed so far;
-    total_blocks is optional (an unbounded stream has progress but no
-    ETA)."""
+    million-block scale.  The streaming replay (storage/stream.py) adds
+    a third on/off signal with the same discipline: {prefetch thread
+    reading/decoding} ∩ {≥1 window in flight} accumulates into
+    disk_hidden_secs, so the engine can report how many storage seconds
+    the read-ahead hid behind device verify.  ETA uses the blocks/sec
+    observed so far; total_blocks is optional (an unbounded stream has
+    progress but no ETA)."""
 
     __slots__ = ("t0", "total", "blocks", "host_secs", "hidden_secs",
-                 "_lock", "_inflight", "_host_since", "_both_since")
+                 "disk_secs", "disk_hidden_secs", "_lock", "_inflight",
+                 "_host_since", "_both_since", "_disk_since",
+                 "_disk_both_since")
 
     def __init__(self, total_blocks: Optional[int] = None):
         self.t0 = _spans.monotonic_now()
@@ -132,10 +144,14 @@ class ProgressTracker:
         self.blocks = 0
         self.host_secs = 0.0
         self.hidden_secs = 0.0
+        self.disk_secs = 0.0
+        self.disk_hidden_secs = 0.0
         self._lock = threading.Lock()
         self._inflight = 0
         self._host_since: Optional[float] = None
         self._both_since: Optional[float] = None
+        self._disk_since: Optional[float] = None
+        self._disk_both_since: Optional[float] = None
         _P_TOTAL.set(total_blocks if total_blocks is not None else 0)
         _P_BLOCKS.set(0)
         _P_INFLIGHT.set(0)
@@ -158,24 +174,50 @@ class ProgressTracker:
                 self.hidden_secs += now - self._both_since
                 self._both_since = None
 
+    # -- prefetch-thread edges (streaming replay) ----------------------------
+    def disk_begin(self) -> None:
+        now = _spans.monotonic_now()
+        with self._lock:
+            self._disk_since = now
+            if self._inflight:
+                self._disk_both_since = now
+
+    def disk_end(self) -> None:
+        now = _spans.monotonic_now()
+        with self._lock:
+            if self._disk_since is not None:
+                self.disk_secs += now - self._disk_since
+                self._disk_since = None
+            if self._disk_both_since is not None:
+                self.disk_hidden_secs += now - self._disk_both_since
+                self._disk_both_since = None
+
     # -- consumer edges ------------------------------------------------------
     def window_submitted(self) -> None:
         now = _spans.monotonic_now()
         with self._lock:
             self._inflight += 1
-            if self._inflight == 1 and self._host_since is not None:
-                self._both_since = now
+            if self._inflight == 1:
+                if self._host_since is not None:
+                    self._both_since = now
+                if self._disk_since is not None:
+                    self._disk_both_since = now
 
     def window_drained(self, n_blocks: int) -> None:
         now = _spans.monotonic_now()
         with self._lock:
             self._inflight -= 1
-            if self._inflight == 0 and self._both_since is not None:
-                self.hidden_secs += now - self._both_since
-                self._both_since = None
+            if self._inflight == 0:
+                if self._both_since is not None:
+                    self.hidden_secs += now - self._both_since
+                    self._both_since = None
+                if self._disk_both_since is not None:
+                    self.disk_hidden_secs += now - self._disk_both_since
+                    self._disk_both_since = None
             self.blocks += n_blocks
             blocks, inflight = self.blocks, self._inflight
             host, hidden = self.host_secs, self.hidden_secs
+            disk, disk_hidden = self.disk_secs, self.disk_hidden_secs
         elapsed = now - self.t0
         rate = blocks / elapsed if elapsed > 0 else 0.0
         _P_BLOCKS.set(blocks)
@@ -184,6 +226,8 @@ class ProgressTracker:
         if self.total and rate > 0:
             _P_ETA.set(round(max(0, self.total - blocks) / rate, 3))
         _P_HIDDEN.set(round(hidden / host, 4) if host > 0 else 0.0)
+        if disk > 0:
+            _S_HIDDEN.set(round(disk_hidden / disk, 4))
 
 
 class _Shared:
@@ -197,7 +241,7 @@ class _Shared:
 
     def __init__(self):
         self.cond = threading.Condition()
-        # (start, sub, reqs, owner, n_seq, t_submit)
+        # (start, sub, reqs, owner, n_seq, t_submit, state_after, point)
         self.pending: deque = deque()
         self.progress: Optional[ProgressTracker] = None
         self.submitted = 0
@@ -292,10 +336,24 @@ def _produce(shared: _Shared, ext_rules, block_iter, ext_state, backend,
             _WINDOW_BLOCKS.observe(n_seq_w)
             if progress is not None:
                 progress.window_submitted()
+            # the window's post-prefix state + tip point ride the entry:
+            # once this window DRAINS clean, `st` is fully verified up to
+            # `pt` — the consumer hands the pair to on_window (the
+            # streaming engine's snapshot seam).  A window that died on
+            # a genuine sequential validation failure carries NO point:
+            # its prefix precedes an invalid block and both drivers
+            # refuse to checkpoint it (retry-later horizon waits DO
+            # checkpoint — their prefix is on the canonical chain)
+            pt = (Point(headers_w[n_seq_w - 1].slot,
+                        headers_w[n_seq_w - 1].hash)
+                  if n_seq_w and (seq_error is None
+                                  or isinstance(seq_error,
+                                                OutsideForecastRange))
+                  else None)
             with shared.cond:
                 shared.pending.append(
                     (shared.seq_done, sub, reqs, owner, n_seq_w,
-                     _spans.monotonic_now()))
+                     _spans.monotonic_now(), st, pt))
                 shared.submitted += 1
                 shared.seq_done += n_seq_w
                 shared.cond.notify_all()
@@ -315,7 +373,7 @@ def _drain(backend, entry) -> tuple:
     """Finish one window's device call; install its carried betas.
     Returns (error, n_valid): error None when every proof held, else
     n_valid is the global index of the first bad block."""
-    start, sub, reqs, owner, n_seq_w, t_submit = entry
+    start, sub, reqs, owner, n_seq_w, t_submit, _st, _pt = entry
     # named distinctly from jax_backend's inner "window.drain" span:
     # bench._rep_overlap pairs submits and drains positionally by name,
     # and a second same-named interval per drain would break the zip.
@@ -349,14 +407,25 @@ def _drain(backend, entry) -> tuple:
 
 def replay_threaded(ext_rules, blocks, ext_state, backend,
                     window: int = 512,
-                    total_blocks: Optional[int] = None):
+                    total_blocks: Optional[int] = None,
+                    tracker: Optional[ProgressTracker] = None,
+                    on_window=None):
     """Run the producer/consumer pipeline to completion; returns the
     same ReplayResult the synchronous driver would (batch.py re-exports
     this as the submit_window path of replay_blocks_pipelined).
 
     `total_blocks` (len(blocks) when the caller knows it) feeds the
     progress tracker's ETA; a streaming replay without it still reports
-    blocks/sec, windows in flight and the hidden fraction."""
+    blocks/sec, windows in flight and the hidden fraction.  `tracker`
+    lets a caller share one ProgressTracker with other pipeline stages
+    (the streaming engine's prefetch thread feeds its disk signal into
+    the same tracker).  `on_window(state, n_done, point)` runs on the
+    consumer thread after each window drains CLEAN: `state` is the
+    fully verified state after that window's prefix and `point` its tip
+    — the snapshot seam.  An exception it raises stops the replay
+    through the normal first-error-wins teardown (producer joined,
+    in-flight windows discarded via finish_window) and re-raises on the
+    caller."""
     from .batch import ReplayResult
 
     if total_blocks is None and hasattr(blocks, "__len__"):
@@ -372,7 +441,8 @@ def replay_threaded(ext_rules, blocks, ext_state, backend,
     stats_fn = getattr(backend, "padding_stats", None)
     pad0 = stats_fn() if stats_fn is not None else None
     shared = _Shared()
-    shared.progress = ProgressTracker(total_blocks)
+    shared.progress = (tracker if tracker is not None
+                       else ProgressTracker(total_blocks))
     t = threading.Thread(
         target=_run_producer,
         args=(shared, ext_rules, iter(blocks), ext_state, backend,
@@ -398,6 +468,13 @@ def replay_threaded(ext_rules, blocks, ext_state, backend,
             if err is not None:
                 error, n_ok = err, n
                 break
+            if on_window is not None and entry[7] is not None:
+                # every proof up to entry's tip point has now held —
+                # entry[6] is a durable resume point.  A hook failure
+                # (snapshot write error, a test's injected kill) rides
+                # the consumer-exception path below: producer joined,
+                # leftovers discarded, exception re-raised
+                on_window(entry[6], n, entry[7])
     finally:
         # wake a permit-blocked producer and wait it out — the pipeline
         # must never leak its thread, least of all on an error path

@@ -14,6 +14,9 @@ attributes time to:
                sharded-mesh build)
     sync       explicit block_until_ready fences draining the async
                dispatch queue before a timed region
+    disk       storage-layer reads + CBOR decode on the streaming
+               replay's prefetch thread (storage/stream.py) — the
+               seconds the read-ahead hides under device verify
 
 Clock discipline: **monotonic only** — `time.perf_counter()` on the
 host, the active runtime's virtual clock under simharness (Sim time in
@@ -48,7 +51,8 @@ from typing import List, Optional
 from ..simharness import runtime as _runtime
 from . import metrics as _metrics
 
-PHASES = ("host-seq", "dispatch", "device", "compile", "sync", "stall")
+PHASES = ("host-seq", "dispatch", "device", "compile", "sync", "stall",
+          "disk")
 
 
 def monotonic_now() -> float:

@@ -9,8 +9,14 @@ from .fs import FsApi, IoFS, MockFS, FsError, crc32
 from .immutabledb import ImmutableDB
 from .volatiledb import VolatileDB
 from .ledgerdb import LedgerDB, DiskPolicy
+from .stream import (
+    BlockPrefetcher, StreamConfig, StreamingReplayEngine,
+    StreamReplayResult,
+)
 
 __all__ = [
     "FsApi", "IoFS", "MockFS", "FsError", "crc32",
     "ImmutableDB", "VolatileDB", "LedgerDB", "DiskPolicy",
+    "BlockPrefetcher", "StreamConfig", "StreamingReplayEngine",
+    "StreamReplayResult",
 ]

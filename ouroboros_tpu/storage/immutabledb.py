@@ -84,12 +84,18 @@ class ImmutableDB:
     def open(cls, fs: FsApi, chunk_size: int = 100,
              validate_all: bool = True) -> "ImmutableDB":
         """Open, validating chunks in order; the first corrupt entry
-        truncates the DB there (Impl/Validation.hs tail truncation)."""
+        truncates the DB there (Impl/Validation.hs tail truncation).
+
+        Chunk numbers come from BOTH file kinds: an orphan `.secondary`
+        whose `.chunk` is gone (a crash between the two deletes, or a
+        lost data file) is corruption at that chunk — its stale index
+        must not survive to mis-describe a future append, and every
+        later chunk is past the corruption point."""
         db = cls(fs, chunk_size)
         fs.mkdirs(DIR)
         chunk_nos = sorted(
-            int(name.split(".")[0]) for name in fs.list_dir(DIR)
-            if name.endswith(".chunk"))
+            {int(name.split(".")[0]) for name in fs.list_dir(DIR)
+             if name.endswith((".chunk", ".secondary"))})
         good = True
         for n in chunk_nos:
             if not good:
@@ -140,8 +146,14 @@ class ImmutableDB:
             end = keep[-1].offset + keep[-1].size if keep else 0
             if chunk_len > end:
                 fs.truncate_file(_chunk_file(n), end)
-            fs.write_file(_secondary_file(n),
-                          b"".join(cbor.dumps(e.encode()) for e in keep))
+            if keep or fs.exists(_chunk_file(n)):
+                fs.write_file(_secondary_file(n),
+                              b"".join(cbor.dumps(e.encode())
+                                       for e in keep))
+            else:
+                # orphan index: no data file at all — drop it rather
+                # than leave an empty stub behind
+                fs.remove(_secondary_file(n))
         return clean
 
     def _index(self, n: int, e: SecondaryEntry) -> None:
@@ -231,6 +243,40 @@ class ImmutableDB:
                 if to_slot is not None and e.slot > to_slot:
                     return
                 yield e, self.fs.read_range(_chunk_file(n), e.offset, e.size)
+
+    # -- chunk-granular streaming (the storage/stream.py read path) ----------
+    def chunk_numbers(self) -> list:
+        return sorted(self._chunks)
+
+    def chunk_blocks(self, n: int,
+                     from_index: int = 0) -> list:
+        """Chunk n's (entry, block bytes) pairs from ONE whole-file read
+        — the streaming replay's disk unit (one fs op per chunk instead
+        of one per block; the reference's iterator equally reads chunk
+        files sequentially, Impl/Iterator.hs)."""
+        entries = self._chunks.get(n, ())
+        if from_index >= len(entries):
+            return []
+        raw = self.fs.read_file(_chunk_file(n))
+        return [(e, bytes(raw[e.offset:e.offset + e.size]))
+                for e in entries[from_index:]]
+
+    def start_after(self, h: Optional[bytes]) -> Optional[tuple]:
+        """(chunk, index) of the first block AFTER the one with hash `h`
+        (None/genesis: the very first block) — the resume cursor for
+        chunk-granular streaming.  None when `h` is unknown or nothing
+        follows it."""
+        if h is None:
+            return (min(self._chunks), 0) if self._chunks else None
+        loc = self._by_hash.get(h)
+        if loc is None:
+            return None
+        n, j = loc[0], loc[1] + 1
+        while n <= max(self._chunks):
+            if j < len(self._chunks.get(n, ())):
+                return (n, j)
+            n, j = n + 1, 0
+        return None
 
     def __len__(self) -> int:
         # count entries, not slots: an EBB and its successor share a slot

@@ -18,7 +18,7 @@ from typing import Any, Callable, Optional, Sequence
 
 from ..chain.block import Point
 from ..utils import cbor
-from .fs import FsApi, FsError
+from .fs import FsApi, FsError, crc32
 
 DIR = ("ledger",)
 
@@ -112,6 +112,14 @@ class LedgerDB:
         return True
 
     # -- on-disk snapshots ----------------------------------------------------
+    # Checksummed snapshot framing (ISSUE 15): MAGIC + CRC-32(body) +
+    # body, where body = CBOR [point, state].  The CRC is what makes a
+    # torn write DETECTABLE on filesystems without atomic whole-file
+    # writes; the tmp-file + rename below is what makes the common case
+    # atomic.  Files without the magic are read as the legacy unframed
+    # format, so pre-existing snapshots stay restorable.
+    SNAP_MAGIC = b"OSNAP1"
+
     @staticmethod
     def _snap_file(slot: int) -> tuple:
         return DIR + (f"snap-{slot:012d}",)
@@ -120,29 +128,80 @@ class LedgerDB:
     def take_snapshot(fs: FsApi, slot: int, point: Point, state: Any,
                       encode_state: Callable[[Any], Any],
                       policy: DiskPolicy = DiskPolicy()) -> None:
-        """Write a snapshot named by slot; trim old ones (OnDisk.hs:343,
-        trimSnapshots)."""
+        """Write a snapshot named by slot, crash-consistently: the bytes
+        land in a `.tmp` sibling first and only an atomic rename
+        publishes the name readers look for — a kill mid-write leaves
+        the previous snapshot set intact (OnDisk.hs takeSnapshot
+        discipline).  Old snapshots are trimmed to the policy
+        (OnDisk.hs:343 trimSnapshots)."""
         fs.mkdirs(DIR)
-        payload = cbor.dumps([point.encode(), encode_state(state)])
-        fs.write_file(LedgerDB._snap_file(slot), payload)
-        snaps = sorted(n for n in fs.list_dir(DIR) if n.startswith("snap-"))
+        body = cbor.dumps([point.encode(), encode_state(state)])
+        payload = (LedgerDB.SNAP_MAGIC
+                   + crc32(body).to_bytes(4, "big") + body)
+        final = LedgerDB._snap_file(slot)
+        tmp = DIR + (final[-1] + ".tmp",)
+        fs.write_file(tmp, payload)
+        fs.rename(tmp, final)
+        snaps = LedgerDB.snapshot_names(fs)
         for name in snaps[:-policy.num_snapshots]:
             fs.remove(DIR + (name,))
+        # sweep staging files orphaned by earlier crashes (kill between
+        # write and rename) — readers already ignore them, but each one
+        # holds a full ledger state of disk forever.  Single-writer
+        # discipline: one engine owns a DB dir at a time, so no live
+        # .tmp can be swept out from under a concurrent writer.
+        for name in fs.list_dir(DIR):
+            if name.endswith(".tmp"):
+                fs.remove(DIR + (name,))
+
+    @staticmethod
+    def snapshot_names(fs: FsApi) -> list:
+        """Published snapshot file names, oldest first (`.tmp` staging
+        files are not snapshots — a crash may leave one behind)."""
+        return sorted(n for n in fs.list_dir(DIR)
+                      if n.startswith("snap-") and not n.endswith(".tmp"))
+
+    @staticmethod
+    def iter_snapshots(fs: FsApi, decode_state: Callable[[Any], Any]):
+        """Yield (slot, point, state) for each READABLE snapshot, newest
+        first.  A corrupt or partial snapshot — bad magic-framed CRC,
+        torn CBOR, undecodable state — is skipped, falling back to the
+        next older one (OnDisk.hs resume; the engine also needs the
+        fallback when the newest snapshot points past a truncated
+        ImmutableDB)."""
+        for name in reversed(LedgerDB.snapshot_names(fs)):
+            try:
+                raw = fs.read_file(DIR + (name,))
+                magic = LedgerDB.SNAP_MAGIC
+                if raw[:len(magic)] == magic:
+                    want = int.from_bytes(raw[len(magic):len(magic) + 4],
+                                          "big")
+                    body = raw[len(magic) + 4:]
+                    if crc32(body) != want:
+                        continue               # torn/corrupt: fall back
+                else:
+                    body = raw                 # legacy unframed snapshot
+                obj = cbor.loads(body)
+                point = Point.decode(obj[0])
+                try:
+                    state = decode_state(obj[1])
+                except Exception:
+                    # the promise is skip-and-fall-back, whatever the
+                    # codec raises: pickle.UnpicklingError on garbage
+                    # legacy bytes, AttributeError/ImportError when a
+                    # state class moved, anything a custom codec throws
+                    continue
+                yield int(name.split("-")[1]), point, state
+            except (cbor.CBORError, FsError, ValueError, IndexError,
+                    EOFError):
+                continue
 
     @staticmethod
     def read_latest_snapshot(fs: FsApi,
                              decode_state: Callable[[Any], Any]
                              ) -> Optional[tuple[int, Point, Any]]:
-        """Newest readable snapshot: (slot, point, state); corrupt snapshots
-        are skipped, falling back to older ones (OnDisk.hs resume)."""
-        snaps = sorted((n for n in fs.list_dir(DIR) if n.startswith("snap-")),
-                       reverse=True)
-        for name in snaps:
-            try:
-                obj = cbor.loads(fs.read_file(DIR + (name,)))
-                point = Point.decode(obj[0])
-                state = decode_state(obj[1])
-                return int(name.split("-")[1]), point, state
-            except (cbor.CBORError, FsError, ValueError, IndexError):
-                continue
+        """Newest readable snapshot: (slot, point, state); corrupt
+        snapshots are skipped, falling back to older ones."""
+        for found in LedgerDB.iter_snapshots(fs, decode_state):
+            return found
         return None

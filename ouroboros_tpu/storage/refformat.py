@@ -251,6 +251,25 @@ class RefDbReader:
         for n in _chunk_numbers(self.fs):
             yield from self.read_chunk(n)
 
+    def iter_entries(self) -> Iterator[RefEntry]:
+        """Secondary-index entries only — no chunk blobs, no CRC: the
+        cheap membership scan resume needs (is this snapshot point
+        still on the chain?) without replaying the data files."""
+        for n in _chunk_numbers(self.fs):
+            primary = self.fs.read_file(primary_file(n))
+            if not primary or primary[0] != VERSION:
+                return
+            offs = [struct.unpack_from(">I", primary, 1 + 4 * i)[0]
+                    for i in range((len(primary) - 1) // 4)]
+            secondary = self.fs.read_file(secondary_file(n))
+            for rel in range(len(offs) - 1):
+                if offs[rel + 1] <= offs[rel]:
+                    continue
+                raw = secondary[offs[rel]:offs[rel] + ENTRY_SIZE]
+                if len(raw) < ENTRY_SIZE:
+                    return
+                yield RefEntry.decode(raw, is_ebb=(rel == 0))
+
     def __iter__(self) -> Iterator[RefBlock]:
         return self.stream()
 
@@ -258,7 +277,10 @@ class RefDbReader:
 class RefImmutableView:
     """Duck-typed read-only stand-in for ImmutableDB on the analyser
     path: stream() yields (entry, block bytes) like ImmutableDB.stream,
-    so db_analyser replays reference-format DBs unchanged."""
+    so db_analyser replays reference-format DBs unchanged.  Membership
+    (`hash in view` — the streaming engine's is-this-snapshot-point-
+    still-on-chain check) scans the index files only, never the chunk
+    blobs."""
 
     def __init__(self, reader: RefDbReader):
         self._r = reader
@@ -266,6 +288,9 @@ class RefImmutableView:
     def stream(self):
         for rb in self._r:
             yield rb.entry, rb.data
+
+    def __contains__(self, h: bytes) -> bool:
+        return any(e.header_hash == h for e in self._r.iter_entries())
 
     def __len__(self) -> int:
         return sum(1 for _ in self._r)

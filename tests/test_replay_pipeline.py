@@ -158,10 +158,14 @@ class AsyncStubBackend(OpensslBackend):
                 "beta_proofs": list(dict.fromkeys(next_beta_proofs))}
 
     def finish_window(self, state):
-        self.finished += 1
         ok = self.verify_mixed(state["reqs"])
         betas = dict(zip(state["beta_proofs"],
                          self.vrf_betas_batch(state["beta_proofs"])))
+        # a window counts as finished when its drain COMPLETES: the
+        # producer overlaps with the whole (slow, CPU-bound) verify, so
+        # max_in_flight == 2 reflects the pipeline design rather than
+        # winning a GIL-slice race against the consumer's first bytecode
+        self.finished += 1
         return ok, betas
 
 
@@ -333,6 +337,91 @@ def test_fold_verdict_path_matches_vector_path(chain):
         if vec.final_state is not None:
             assert (fold.final_state.ledger.state_hash()
                     == vec.final_state.ledger.state_hash())
+
+
+def test_on_window_hook_identical_on_both_drivers(chain):
+    """The on_window snapshot seam (ISSUE 15): fires once per FULLY
+    verified window with the post-window state and tip point, on the
+    threaded driver and the synchronous fallback alike — same windows,
+    same points, same state hashes (the streaming engine's checkpoints
+    cannot depend on which driver ran)."""
+    from ouroboros_tpu.crypto.backend import GLOBAL_BETA_CACHE
+    ext, blocks, final = chain
+
+    def run(backend):
+        calls = []
+        GLOBAL_BETA_CACHE.clear()
+        res = replay_blocks_pipelined(
+            ext, blocks, ext.initial_state(), backend=backend, window=4,
+            on_window=lambda st, n, pt: calls.append(
+                (n, pt.slot, st.ledger.state_hash())))
+        assert res.all_valid
+        return calls, res
+
+    threaded, rt = run(AsyncStubBackend())
+    sync, rs = run(BACKEND)                 # no submit_window: fallback
+    assert threaded == sync
+    assert [n for n, _s, _h in threaded] == [4, 8, 12, 16, 20, 24]
+    # the last hook state IS the final state
+    assert threaded[-1][2] == rt.final_state.ledger.state_hash()
+    assert threaded[-1][1] == blocks[-1].slot
+
+
+def test_on_window_hook_not_called_past_first_error(chain):
+    """A tampered window: the hook fires for windows before the bad
+    block only — a checkpoint of unverified state would poison resume."""
+    ext, blocks, _final = chain
+    tampered = _tamper(blocks, 9)           # window 3 at window=4
+    calls = []
+    res = replay_blocks_pipelined(
+        ext, tampered, ext.initial_state(), backend=AsyncStubBackend(),
+        window=4, on_window=lambda st, n, pt: calls.append(n))
+    assert not res.all_valid
+    assert calls == [4, 8]
+
+    # inspect what the synchronous driver does with the same chain
+    calls2 = []
+    res2 = replay_blocks_pipelined(
+        ext, tampered, ext.initial_state(), backend=BACKEND, window=4,
+        on_window=lambda st, n, pt: calls2.append(n))
+    assert not res2.all_valid
+    assert calls2 == [4, 8]
+
+    # a SEQUENTIAL failure (envelope break from a dropped block, inside
+    # window 3) is equally checkpoint-free past the last clean window,
+    # on both drivers — the verified prefix precedes an invalid block
+    cut = list(blocks[:10]) + list(blocks[11:])
+    for backend in (AsyncStubBackend(), BACKEND):
+        calls3 = []
+        res3 = replay_blocks_pipelined(
+            ext, cut, ext.initial_state(), backend=backend, window=4,
+            on_window=lambda st, n, pt: calls3.append(n))
+        assert not res3.all_valid
+        assert calls3 == [4, 8]
+
+
+def test_on_window_hook_exception_is_clean_stop(chain):
+    """A hook failure (snapshot write error, the kill/resume test's
+    hard stop) re-raises on the caller through the normal teardown:
+    producer joined, every optimistic submission finished."""
+    ext, blocks, _final = chain
+
+    class SnapshotDied(Exception):
+        pass
+
+    def hook(st, n, pt):
+        if n >= 8:
+            raise SnapshotDied(f"disk full at block {n}")
+
+    sb = AsyncStubBackend()
+    s0, f0 = _producer_counters()
+    with pytest.raises(SnapshotDied):
+        replay_blocks_pipelined(ext, blocks, ext.initial_state(),
+                                backend=sb, window=4, on_window=hook)
+    assert sb.submitted == sb.finished > 0   # no leaked device work
+    s1, f1 = _producer_counters()
+    assert (s1 - s0, f1 - f0) == (1, 1)
+    assert not _producer_threads_alive()
 
 
 def test_error_with_producer_ahead_no_leaks(chain):

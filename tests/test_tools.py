@@ -55,6 +55,40 @@ def test_validate_reapply_and_full_agree(synth_db):
     assert h1 == h2, "full validation and reapply disagree on final state"
 
 
+def test_validate_snapshot_every_and_resume(synth_db, tmp_path):
+    """ISSUE 15: `--snapshot-every` checkpoints the verified state
+    during full validation (crash-consistent LedgerDB snapshots in the
+    DB dir) and `--resume` restarts from the newest one — replaying
+    ZERO blocks to the same state hash, reporting where it resumed."""
+    import shutil
+    d = str(tmp_path / "snapdb")
+    shutil.copytree(synth_db, d)
+    r1 = _run("tools/db_analyser.py", d, "--validate", "full",
+              "--backend", "openssl", "--window", "16",
+              "--snapshot-every", "10")
+    assert r1.returncode == 0, r1.stderr
+    i1 = json.loads(r1.stdout)
+    assert i1["blocks"] == 40
+    assert i1["stream"]["snapshots_written"] >= 2
+    snaps = sorted(os.listdir(os.path.join(d, "ledger")))
+    assert snaps and all(n.startswith("snap-") for n in snaps)
+    r2 = _run("tools/db_analyser.py", d, "--validate", "full",
+              "--backend", "openssl", "--window", "16", "--resume")
+    assert r2.returncode == 0, r2.stderr
+    i2 = json.loads(r2.stdout)
+    assert i2["state_hash"] == i1["state_hash"]
+    assert i2["blocks"] == 0                      # nothing re-replayed
+    assert i2["stream"]["resumed_from_slot"] is not None
+    # plain validation (no flags) stays read-only: no ledger/ dir
+    d2 = str(tmp_path / "plaindb")
+    shutil.copytree(synth_db, d2)
+    r3 = _run("tools/db_analyser.py", d2, "--validate", "full",
+              "--backend", "openssl", "--window", "16")
+    assert r3.returncode == 0, r3.stderr
+    assert json.loads(r3.stdout)["state_hash"] == i1["state_hash"]
+    assert not os.path.exists(os.path.join(d2, "ledger"))
+
+
 def test_validate_detects_corruption(synth_db, tmp_path):
     import shutil
     bad = str(tmp_path / "bad")
@@ -160,6 +194,16 @@ def test_bench_smoke_parity_gate():
     assert sv["backpressure"]["parity"] is True
     for leg in ("saturated", "light_load", "backpressure"):
         assert sv[leg]["leaked_threads"] == 0
+    # ISSUE 15: the streaming-engine probe — the same smoke chain
+    # replayed FROM DISK through storage/stream.py (prefetch thread +
+    # snapshots) at an already-compiled window shape, then a resumed
+    # reopen restoring the tip checkpoint to the same hash
+    st = res["stream_probe"]
+    assert st["ok"] is True
+    assert st["state_hash_parity"] and st["resume_parity"]
+    assert st["threads_leaked"] == 0
+    assert st["stats"]["chunks_read"] >= 1
+    assert st["stats"]["snapshots_written"] >= 1
     assert res["blocks"] == 8
 
 
@@ -545,6 +589,46 @@ def test_obsreport_renders_serve_section(tmp_path):
     r2 = _run("-m", "tools.obsreport", "BENCH_r05.json")
     assert r2.returncode == 0
     assert "verification service" not in r2.stdout
+
+
+def test_obsreport_renders_stream_section(tmp_path):
+    """ISSUE 15 satellite: a round carrying the ``stream`` section (the
+    disk->decode->verify engine leg) renders the read-ahead hiding
+    accounting and the snapshot/restart timings; rounds without one
+    render unchanged."""
+    doc = {
+        "metric": "shelley_replay_proofs_per_sec", "value": 20000.0,
+        "unit": "proofs/s", "vs_baseline": 15.0,
+        "stream": {
+            "blocks": 10000, "replay_secs": 4.1, "chunks_read": 125,
+            "blocks_decoded": 10000, "bytes_read": 6_400_000,
+            "era_crossings": 1, "prefetch_stalls": 12, "read_ahead": 4,
+            "disk_secs": 1.9, "disk_hidden_secs": 1.7,
+            "disk_hidden_frac": 0.894, "host_seq_secs": 0.9,
+            "host_hidden_secs": 0.8, "snapshots_written": 5,
+            "snapshot_write_secs": 0.21, "restore_secs": 0.0,
+            "resumed_from_slot": None,
+            "state_hash_parity": True, "proofs_per_sec": 14634.1,
+            "restart": {"restore_secs": 0.034, "blocks_replayed": 0,
+                        "state_hash_parity": True},
+        },
+    }
+    p = tmp_path / "stream.json"
+    p.write_text(json.dumps(doc))
+    r = _run("-m", "tools.obsreport", str(p))
+    assert r.returncode == 0, r.stderr
+    assert "streaming replay (disk -> decode -> verify, read-ahead 4" \
+        in r.stdout
+    assert "89% of disk+decode ran while a window was in flight" \
+        in r.stdout
+    assert "era crossings in-stream" in r.stdout
+    assert "snapshots: 5 written" in r.stdout
+    assert "restart probe" in r.stdout and "0.0340" in r.stdout
+    assert "state-hash parity True" in r.stdout
+    # a round without the section renders unchanged
+    r2 = _run("-m", "tools.obsreport", "BENCH_r05.json")
+    assert r2.returncode == 0
+    assert "streaming replay" not in r2.stdout
 
 
 def test_obsreport_live_flag_wired():

@@ -14,12 +14,22 @@ batch per window — with `--backend {ref,openssl,jax}` selecting the
 CryptoBackend.  This is the BASELINE.md harness: blocks/sec + proofs/sec
 per backend, plus the final ledger state hash for replay-parity checks.
 
+Full validation routes through the STREAMING replay engine
+(ouroboros_tpu/storage/stream.py, ISSUE 15): a bounded read-ahead
+prefetcher streams ImmutableDB chunks and decodes them on a background
+thread while earlier windows verify, `--snapshot-every N` checkpoints
+the verified ledger state every N slots (crash-consistent LedgerDB
+snapshots), and `--resume` restarts from the newest usable snapshot
+instead of genesis — the db-analyser validate-mainnet path made both
+disk-streaming and restartable.
+
 Usage:
   python tools/db_analyser.py DIR --analysis show-slot-block-no
   python tools/db_analyser.py DIR --analysis count-tx-outputs
   python tools/db_analyser.py DIR --analysis show-header-size
   python tools/db_analyser.py DIR --analysis validate \\
-      [--validate reapply|full] [--backend ref|openssl|jax] [--window 256]
+      [--validate reapply|full] [--backend ref|openssl|jax] [--window 256] \\
+      [--snapshot-every SLOTS] [--resume] [--read-ahead W]
 """
 from __future__ import annotations
 
@@ -62,8 +72,19 @@ def load_db(db_dir: str):
         from ouroboros_tpu.eras.cardano import (
             cardano_block_decode, cardano_setup,
         )
+        shelley_config = None
+        if "slots_per_kes_period" in cfg:
+            # db_synth sized the KES period to the chain length
+            # (long-chain DBs); mirror cardano_setup's defaults with
+            # only that knob overridden
+            from ouroboros_tpu.eras.shelley import TPraosConfig
+            shelley_config = TPraosConfig(
+                k=8, epoch_length=cfg["epoch_length"],
+                slots_per_kes_period=cfg["slots_per_kes_period"],
+                kes_depth=5, max_kes_evolutions=30)
         _eras, rules, _nodes = cardano_setup(
             cfg["nodes"], epoch_length=cfg["epoch_length"],
+            shelley_config=shelley_config,
             seed=cfg["seed"].encode(),
             allegra_epoch=cfg.get("allegra_epoch"),
             mary_epoch=cfg.get("mary_epoch"))
@@ -183,14 +204,15 @@ HEADER_PROOFS = {"mock-praos": 2, "shelley": 4,
 
 
 def analysis_validate(db, rules, decode, backend_name: str, mode: str,
-                      window: int, out, hdr_proofs: int = 2):
-    from ouroboros_tpu.consensus.batch import replay_blocks_pipelined
-
+                      window: int, out, hdr_proofs: int = 2,
+                      db_dir: str = None, snapshot_every: int = 0,
+                      resume: bool = False, read_ahead: int = 4):
     backend = make_backend(backend_name) if mode == "full" else None
     hdr_count = hdr_proofs if callable(hdr_proofs) \
         else (lambda b, n=hdr_proofs: n)
     ext = rules.initial_state()
     counts = {"blocks": 0, "proofs": 0}
+    stream_stats = None
     t0 = time.time()
     if mode == "reapply":
         for entry, raw in db.stream():
@@ -200,19 +222,37 @@ def analysis_validate(db, rules, decode, backend_name: str, mode: str,
                                                    for tx in b.body)
             ext = rules.tick_then_reapply(ext, b)
     else:
-        def stream_blocks():
-            for entry, raw in db.stream():
-                b = decode(raw)
-                counts["blocks"] += 1
-                counts["proofs"] += hdr_count(b) + sum(len(tx.witnesses)
-                                                       for tx in b.body)
-                yield b
-        res = replay_blocks_pipelined(rules, stream_blocks(), ext,
-                                      backend=backend, window=window)
+        # the streaming engine: disk + decode on a prefetch thread,
+        # DiskPolicy-driven snapshots, resume-from-latest-snapshot
+        from ouroboros_tpu.storage import (
+            DiskPolicy, IoFS, StreamConfig, StreamingReplayEngine,
+        )
+
+        def counting_decode(raw: bytes):
+            b = decode(raw)
+            counts["blocks"] += 1
+            counts["proofs"] += hdr_count(b) + sum(len(tx.witnesses)
+                                                   for tx in b.body)
+            return b
+
+        policy = DiskPolicy(
+            snapshot_interval_slots=snapshot_every
+            if snapshot_every > 0 else (1 << 62))
+        engine = StreamingReplayEngine(
+            IoFS(db_dir), db, rules, counting_decode, backend=backend,
+            config=StreamConfig(
+                window=window, read_ahead=read_ahead, policy=policy,
+                resume=bool(resume),
+                # plain validation stays read-only on the DB dir;
+                # --resume alone still writes the tip checkpoint so the
+                # NEXT run restarts instantly
+                take_snapshots=snapshot_every > 0 or bool(resume)))
+        res = engine.replay()
         if not res.all_valid:
             raise SystemExit(
                 f"validation FAILED at block {res.n_valid}: {res.error}")
         ext = res.final_state
+        stream_stats = res.stats
     secs = time.time() - t0
     blocks, proofs = counts["blocks"], counts["proofs"]
     out.write(json.dumps({
@@ -225,6 +265,7 @@ def analysis_validate(db, rules, decode, backend_name: str, mode: str,
         "proofs_per_sec": round(proofs / secs, 1),
         "state_hash": ext.ledger.state_hash().hex(),
         "tip_slot": ext.header.tip.slot if ext.header.tip else None,
+        **({"stream": stream_stats} if stream_stats is not None else {}),
     }) + "\n")
 
 
@@ -321,6 +362,17 @@ def main() -> None:
                     choices=["ref", "openssl", "cpp", "jax"])
     ap.add_argument("--window", type=int, default=256,
                     help="blocks per device batch (full validation)")
+    ap.add_argument("--snapshot-every", type=int, default=0,
+                    metavar="SLOTS",
+                    help="checkpoint the verified ledger state every N "
+                         "slots during full validation (crash-"
+                         "consistent LedgerDB snapshots; 0 = never)")
+    ap.add_argument("--resume", action="store_true",
+                    help="restart full validation from the newest "
+                         "usable snapshot instead of genesis")
+    ap.add_argument("--read-ahead", type=int, default=4, metavar="W",
+                    help="prefetch bound in windows for the streaming "
+                         "engine (full validation)")
     args = ap.parse_args()
 
     if args.analysis == "validate-real":
@@ -338,7 +390,11 @@ def main() -> None:
     else:
         analysis_validate(db, rules, decode, args.backend, args.validate,
                           args.window, out,
-                          hdr_proofs=HEADER_PROOFS.get(cfg["protocol"], 2))
+                          hdr_proofs=HEADER_PROOFS.get(cfg["protocol"], 2),
+                          db_dir=args.db,
+                          snapshot_every=args.snapshot_every,
+                          resume=args.resume,
+                          read_ahead=args.read_ahead)
 
 
 if __name__ == "__main__":

@@ -309,10 +309,29 @@ def synth_cardano(args) -> dict:
     # Byron spans >= 2 epochs so the chain contains an EBB with a same-slot
     # Byron successor (the EBB layout the storage layer must handle)
     fork_epoch = max(2, total_epochs // 4)
-    allegra_epoch = fork_epoch + max(1, total_epochs // 4)
-    mary_epoch = allegra_epoch + max(1, total_epochs // 4)
+    if getattr(args, "eras", "ladder") == "byron-shelley":
+        # the two-era chain of the streaming-replay scenario (ISSUE 15):
+        # Byron EBBs -> ONE translation -> a long Shelley tail, no
+        # intra-Shelley hops — the minimal shape that still crosses the
+        # hard fork mid-stream
+        allegra_epoch = mary_epoch = None
+    else:
+        allegra_epoch = fork_epoch + max(1, total_epochs // 4)
+        mary_epoch = allegra_epoch + max(1, total_epochs // 4)
+    # KES periods must cover the whole chain (synth_shelley discipline):
+    # cardano_setup's default 50 slots/period exhausts the depth-5 key's
+    # 30 usable evolutions after ~1500 slots, capping chains well below
+    # the >=10k-block streaming scenario.  Sized here and recorded in
+    # config.json so db_analyser rebuilds the identical setup.
+    from ouroboros_tpu.eras.shelley import TPraosConfig
+    slots_per_kes_period = max(50, (args.blocks * 2) // 30 + 1)
+    shelley_config = TPraosConfig(
+        k=8, epoch_length=epoch_length,
+        slots_per_kes_period=slots_per_kes_period,
+        kes_depth=5, max_kes_evolutions=30)
     eras, rules, nodes = cardano_setup(
-        args.pools, epoch_length=epoch_length, seed=args.seed.encode(),
+        args.pools, epoch_length=epoch_length,
+        shelley_config=shelley_config, seed=args.seed.encode(),
         allegra_epoch=allegra_epoch, mary_epoch=mary_epoch)
 
     os.makedirs(args.out, exist_ok=True)
@@ -322,6 +341,7 @@ def synth_cardano(args) -> dict:
             "epoch_length": epoch_length, "seed": args.seed,
             "fork_epoch": fork_epoch, "allegra_epoch": allegra_epoch,
             "mary_epoch": mary_epoch, "chunk_size": args.chunk_size,
+            "slots_per_kes_period": slots_per_kes_period,
         }, fh, indent=2)
     fs = IoFS(args.out)
     db = open_out_db(fs, args)
@@ -332,7 +352,9 @@ def synth_cardano(args) -> dict:
     slot = 0
     forged = 0
     update_sent = False
-    feature_todo = {ALLEGRA, MARY}      # one feature tx per new era
+    # one feature tx per new era (none when the ladder stops at Shelley)
+    feature_todo = ({ALLEGRA, MARY} if allegra_epoch is not None
+                    else set())
     t0 = time.time()
 
     def append(blk):
@@ -443,6 +465,11 @@ def main() -> None:
     ap.add_argument("--format", default="native",
                     choices=["native", "reference"],
                     help="on-disk dialect: our CBOR-indexed ImmutableDB or the reference .primary/.secondary layout")
+    ap.add_argument("--eras", default="ladder",
+                    choices=["ladder", "byron-shelley"],
+                    help="cardano era span: the full "
+                         "Byron->Shelley->Allegra->Mary ladder, or stop "
+                         "at Shelley (the streaming-replay e2e shape)")
     ap.add_argument("--seed", default="db-synth")
     args = ap.parse_args()
 

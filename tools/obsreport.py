@@ -26,6 +26,10 @@ recorded).  For a bench round it prints, in order:
 - the ``overlap`` section (ISSUE 8): host-seq seconds hidden under
   in-flight device windows, hidden fraction and producer permit stalls
   — cross-rep medians;
+- the ``stream`` section (ISSUE 15), when the round ran the streaming
+  disk->decode->verify engine: read-ahead depth, disk+decode seconds
+  hidden under device verify, snapshot write/restore timings and the
+  restart probe — rounds without one render unchanged;
 - the precompute cache stats (hit/miss/device_fill/eviction);
 - the registry metrics snapshot (the deterministic subset bench embeds).
 
@@ -165,6 +169,14 @@ def render(doc: dict) -> str:
         out.append("no 'overlap' section (round predates the threaded "
                    "producer/consumer replay attribution)")
 
+    # -- streaming replay section (ISSUE 15) --------------------------------
+    # rounds without one render unchanged: the section only appears once
+    # a bench round ran the disk->decode->verify engine
+    stream = doc.get("stream")
+    if stream:
+        out.append("")
+        out += _render_stream(stream)
+
     # -- verification-service serve section (ISSUE 12) ----------------------
     serve = doc.get("serve")
     if serve:
@@ -196,6 +208,49 @@ def render(doc: dict) -> str:
     else:
         out.append("no 'metrics' section")
     return "\n".join(out) + "\n"
+
+
+def _render_stream(st: dict) -> List[str]:
+    """The ``stream`` section of a bench round (ISSUE 15): the
+    disk->decode->verify engine's read-ahead accounting (how many
+    storage seconds hid under device verify), and the snapshot write /
+    restore timings behind `db_analyser --resume`."""
+    out: List[str] = []
+    out.append(f"streaming replay (disk -> decode -> verify, read-ahead "
+               f"{st.get('read_ahead', '?')} windows):")
+    rows = [
+        ["blocks streamed", st.get("blocks", "-")],
+        ["chunks read", st.get("chunks_read", "-")],
+        ["bytes read", st.get("bytes_read", "-")],
+        ["era crossings in-stream", st.get("era_crossings", "-")],
+        ["prefetch stalls (reader ahead)", st.get("prefetch_stalls",
+                                                  "-")],
+        ["disk+decode secs", _fmt_secs(st.get("disk_secs"))],
+        ["  of which hidden under device", _fmt_secs(
+            st.get("disk_hidden_secs"))],
+    ]
+    out += _table(rows, ["quantity", "value"])
+    hf = st.get("disk_hidden_frac")
+    if isinstance(hf, (int, float)):
+        out.append(f"{100 * hf:.0f}% of disk+decode ran while a window "
+                   f"was in flight on device — the read-ahead's hiding "
+                   f"power (same reading as the host-seq overlap above)")
+    snaps = st.get("snapshots_written")
+    if snaps is not None:
+        out.append(f"snapshots: {snaps} written in "
+                   f"{_fmt_secs(st.get('snapshot_write_secs'))}s; "
+                   f"restore scan {_fmt_secs(st.get('restore_secs'))}s"
+                   + (f"; resumed from slot {st['resumed_from_slot']}"
+                      if st.get("resumed_from_slot") is not None
+                      else ""))
+    restart = st.get("restart")
+    if restart:
+        out.append(f"restart probe: reopened from the tip snapshot in "
+                   f"{_fmt_secs(restart.get('restore_secs'))}s, "
+                   f"{restart.get('blocks_replayed', '?')} blocks "
+                   f"re-replayed, state-hash parity "
+                   f"{restart.get('state_hash_parity')}")
+    return out
 
 
 def _render_serve(serve: dict) -> List[str]:
